@@ -1,0 +1,35 @@
+"""mapreduce_tpu -- a TPU-native iterative, fault-tolerant MapReduce framework.
+
+A ground-up rebuild of the capabilities of lua-mapreduce (reference at
+/root/reference, surveyed in SURVEY.md): the same user contract --
+``taskfn / mapfn / partitionfn / combinerfn / reducefn / finalfn`` with
+``"loop"``-style iteration, job retry/failure accounting, pluggable
+intermediate storage, per-phase statistics, and distributed data-parallel
+SGD -- re-designed TPU-first:
+
+  * control plane: a host-side coordinator (in-process or shared-dir
+    document store) instead of MongoDB collections (cnn.lua/task.lua);
+  * data plane, general path: sorted record files + k-way merge like the
+    reference's GridFS shuffle (job.lua, fs.lua, heap.lua), for arbitrary
+    Python map/reduce bodies;
+  * data plane, device path: one SPMD XLA program over a jax.sharding.Mesh
+    -- per-shard map + local segment-reduce combine, hash partition,
+    all_to_all over ICI, segmented sort/reduce (engine/);
+  * training: weights resident in HBM, gradient psum over the mesh
+    (models/), replacing the reference's serialize-through-GridFS SGD
+    (examples/APRIL-ANN/common.lua).
+
+Facade parity: reference mapreduce/init.lua:25-38 exports
+{worker, server, utils, tuple, persistent_table, utest}.
+"""
+
+__version__ = "0.1.0"
+
+from .utils import constants  # noqa: F401
+from .utils.constants import STATUS, TASK_STATUS  # noqa: F401
+from .core import interning  # noqa: F401
+from .core.heap import Heap  # noqa: F401
+
+# heavier submodules (server/worker/engine) are imported lazily by users:
+#   from mapreduce_tpu.server import Server
+#   from mapreduce_tpu.worker import Worker

@@ -1,0 +1,2 @@
+from .heap import Heap  # noqa: F401
+from . import interning  # noqa: F401

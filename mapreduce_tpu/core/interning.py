@@ -1,0 +1,90 @@
+"""Interned, immutable tuples usable as map/reduce keys.
+
+Parity with mapreduce/tuple.lua (constructor tuple.lua:250-303, Jenkins-style
+hash tuple.lua:121-140, weak bucket table with hole compaction
+tuple.lua:167-215, ``tuple.stats`` tuple.lua:332-343).  The reference needs
+hash-consing because Lua tables compare by identity; Python tuples already
+compare by value, so the semantic payload here is (a) *identity* interning --
+``intern(x) is intern(y)`` when ``x == y`` -- which the server uses for
+duplicate-key detection in taskfn emissions (server.lua:256-272), and
+(b) boundedness: entries no longer referenced outside the table are purged.
+CPython cannot weak-reference tuple subclasses, so instead of weak values we
+keep the reference's *hole compaction* strategy, using refcounts to detect
+dead entries (compaction runs when the table doubles, and from ``stats``).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Tuple
+
+
+class InternedTuple(tuple):
+    """Marker subclass: an interned canonical tuple."""
+
+    __slots__ = ()
+
+
+_table: dict = {}
+_hits = 0
+_misses = 0
+_next_compact = 1024
+
+
+def intern(*items: Any) -> InternedTuple:
+    """Return the canonical interned tuple for *items*.
+
+    Nested tuples/lists are interned recursively, mirroring the reference's
+    recursive constructor (tuple.lua:250-303).
+    """
+    global _hits, _misses, _next_compact
+    canon = tuple(
+        intern(*x) if isinstance(x, (tuple, list)) else x for x in items
+    )
+    got = _table.get(canon)
+    if got is not None:
+        _hits += 1
+        return got
+    _misses += 1
+    it = InternedTuple(canon)
+    _table[canon] = it
+    if len(_table) >= _next_compact:
+        compact()
+        _next_compact = max(1024, 2 * len(_table))
+    return it
+
+
+def compact() -> int:
+    """Purge entries with no references outside the intern table (the
+    reference's weak-value + hole-compaction behavior, tuple.lua:167-215).
+
+    A dead entry's only refs are the table's value slot and ``getrefcount``'s
+    argument => refcount <= 2 means dead (indexing ``_table[k]`` directly
+    avoids the extra refs an ``items()`` loop would hold).  Runs to fixpoint
+    so parents freed in one pass release nested tuples in the next.  Returns
+    the number of purged entries.
+    """
+    purged = 0
+    while True:
+        dead = [k for k in list(_table) if sys.getrefcount(_table[k]) <= 2]
+        if not dead:
+            return purged
+        purged += len(dead)
+        # pop-as-we-delete so no local binding (a loop variable or the list
+        # itself) keeps a purged key alive into the next pass -- purged
+        # parent keys reference their children and would mask them
+        while dead:
+            del _table[dead.pop()]
+        del dead
+
+
+def stats() -> dict:
+    """Intern-table introspection (reference: tuple.stats tuple.lua:332-343)."""
+    compact()
+    return {"size": len(_table), "hits": _hits, "misses": _misses}
+
+
+def clear_stats() -> None:
+    global _hits, _misses
+    _hits = 0
+    _misses = 0

@@ -1,0 +1,1 @@
+from . import constants, hashing, iterators, serialization  # noqa: F401

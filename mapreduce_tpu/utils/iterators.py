@@ -1,0 +1,93 @@
+"""Line iterators and the k-way merge powering the shuffle's reduce side.
+
+Parity with mapreduce/utils.lua: ``gridfs_lines_iterator`` (chunk-boundary-
+aware line reader, utils.lua:133-200) becomes a plain buffered line reader
+over the storage abstraction; ``merge_iterator`` (heap-based k-way merge
+concatenating the value lists of equal keys across sorted per-mapper files,
+utils.lua:206-271) is reimplemented over parsed records with a total key
+order (serialization.sort_key).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Callable, Iterable, Iterator, List, Sequence, Tuple
+
+from .serialization import parse_record, sort_key
+
+Record = Tuple[Any, Any]
+
+
+def lines_iterator(readable) -> Iterator[str]:
+    """Iterate text lines of an open file-like object, stripping newlines."""
+    for line in readable:
+        if isinstance(line, bytes):
+            line = line.decode("utf-8")
+        line = line.rstrip("\n")
+        if line:
+            yield line
+
+
+def records_iterator(lines: Iterable[str]) -> Iterator[Record]:
+    for line in lines:
+        yield parse_record(line)
+
+
+def merge_iterator(
+    sources: Sequence[Callable[[], Iterator[Record]]],
+) -> Iterator[Record]:
+    """K-way merge of sorted record streams.
+
+    Each *source* is a zero-arg factory returning an iterator of
+    ``(key, value_list)`` records sorted ascending by ``sort_key(key)``.
+    Yields ``(key, concatenated_value_list)`` with equal keys across streams
+    merged, exactly like the reference's merge (utils.lua:238-246): the
+    reduce fn then sees *all* values for a key at once.
+    """
+    # entries: (sort_key, source_index, key, values, iterator).  The source
+    # index is unique among live entries, so tuple comparison never reaches
+    # the iterator element -- plain heapq is safe (and C-fast); it also makes
+    # equal keys concatenate in source order, so the merge is deterministic
+    # (the reference's pop order among equal keys is heap-arbitrary).
+    heap: List[tuple] = []
+    for idx, factory in enumerate(sources):
+        it = iter(factory())
+        first = next(it, None)
+        if first is not None:
+            key, values = first
+            heap.append((sort_key(key), idx, key, list(values), it))
+    heapq.heapify(heap)
+
+    while heap:
+        skey, idx, key, values, it = heapq.heappop(heap)
+        # drain every stream whose head has the same key
+        while heap and heap[0][0] == skey:
+            _, idx2, _, more, other_it = heapq.heappop(heap)
+            values.extend(more)
+            nxt = next(other_it, None)
+            if nxt is not None:
+                k2, v2 = nxt
+                heapq.heappush(heap, (sort_key(k2), idx2, k2, list(v2), other_it))
+        nxt = next(it, None)
+        if nxt is not None:
+            k2, v2 = nxt
+            # streams are sorted with unique keys per file (map output is
+            # grouped by key, job.lua:196-215), so the next record's key is
+            # strictly greater.
+            heapq.heappush(heap, (sort_key(k2), idx, k2, list(v2), it))
+        yield key, values
+
+
+def sorted_grouped(records: Iterable[Record]) -> List[Record]:
+    """Group an unsorted record stream by key and sort by the total order --
+    the map-side sort before writing partitions (job.lua:194)."""
+    acc: dict = {}
+    order: dict = {}
+    for key, values in records:
+        sk = sort_key(key)
+        if sk in acc:
+            acc[sk].extend(values)
+        else:
+            acc[sk] = list(values)
+            order[sk] = key
+    return [(order[sk], acc[sk]) for sk in sorted(acc.keys())]

@@ -1,0 +1,29 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX loads.
+
+Multi-chip hardware is not available in CI; all sharding/collective tests run
+over ``--xla_force_host_platform_device_count=8`` CPU devices (the rebuild's
+answer to the reference's "fake cluster = N local processes + localhost ssh",
+SURVEY.md §4).
+"""
+
+import os
+import sys
+
+# force, not setdefault: the machine env pins JAX_PLATFORMS=axon (the real
+# TPU tunnel); correctness tests must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The machine's sitecustomize registers the axon PJRT plugin in every
+# interpreter; the env var alone has been observed to still let backend
+# init touch the (sometimes flaky) TPU tunnel.  Pinning via jax.config is
+# authoritative.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

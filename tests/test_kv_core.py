@@ -1,0 +1,260 @@
+"""Unit tests for the KV core: heap, interning, serialization, hashing,
+merge iterator.  Mirrors the reference's embedded utest suites
+(heap.lua:99-118, tuple.lua:309-328, utils.lua:340-406) without needing a
+live service (SURVEY.md §4 implication)."""
+
+import gc
+import io
+import random
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.core.heap import Heap
+from mapreduce_tpu.core import interning
+from mapreduce_tpu.utils import hashing
+from mapreduce_tpu.utils.iterators import (
+    lines_iterator,
+    merge_iterator,
+    records_iterator,
+    sorted_grouped,
+)
+from mapreduce_tpu.utils.serialization import (
+    check_serializable,
+    parse_record,
+    serialize_record,
+    sort_key,
+    write_records,
+)
+
+
+# --- heap (reference heap.lua:99-118 pushes shuffled numbers, pops sorted) --
+
+def test_heap_sorts_random_input():
+    rng = random.Random(1234)
+    values = [rng.randint(0, 10000) for _ in range(1000)]
+    h = Heap()
+    for v in values:
+        h.push(v)
+    assert len(h) == 1000
+    out = [h.pop() for _ in range(len(h))]
+    assert out == sorted(values)
+    assert h.empty()
+
+
+def test_heap_custom_comparator_and_top():
+    h = Heap(less=lambda a, b: a > b)  # max-heap
+    for v in [3, 1, 4, 1, 5]:
+        h.push(v)
+    assert h.top() == 5
+    assert [h.pop() for _ in range(len(h))] == [5, 4, 3, 1, 1]
+    with pytest.raises(IndexError):
+        h.pop()
+
+
+def test_heap_clear():
+    h = Heap()
+    h.push(1)
+    h.clear()
+    assert h.empty()
+
+
+# --- interning (reference tuple.lua:309-328: identity, nesting, weakness) --
+
+def test_intern_identity():
+    a = interning.intern(1, "x", 2.5)
+    b = interning.intern(1, "x", 2.5)
+    assert a is b
+    assert a == (1, "x", 2.5)
+    assert hash(a) == hash((1, "x", 2.5))
+
+
+def test_intern_nested_lists_and_tuples():
+    a = interning.intern(1, (2, 3))
+    b = interning.intern(1, [2, 3])
+    assert a is b
+    assert a[1] is interning.intern(2, 3)
+
+
+def test_intern_compaction_purges_dead_entries():
+    t = interning.intern("ephemeral-key", 42)
+    key = tuple(t)
+    assert key in interning._table
+    del t
+    gc.collect()
+    interning.compact()
+    assert key not in interning._table  # dead entry purged
+
+
+def test_intern_compaction_releases_nested_chains():
+    t = interning.intern("outer", ("inner-unique", 1))
+    outer_key, inner_key = tuple(t), ("inner-unique", 1)
+    interning.compact()
+    # parent alive => both entries survive compaction
+    assert outer_key in interning._table and inner_key in interning._table
+    del t, outer_key
+    gc.collect()
+    interning.compact()
+    assert ("outer", ("inner-unique", 1)) not in interning._table
+    assert inner_key not in interning._table  # fixpoint freed the chain
+
+
+def test_intern_usable_as_dict_key():
+    d = {interning.intern("a", 1): "v"}
+    assert d[interning.intern("a", 1)] == "v"
+
+
+# --- serialization (reference utils.lua escape/serialize + load-per-line) --
+
+@pytest.mark.parametrize(
+    "key,values",
+    [
+        ("word", [1, 2, 3]),
+        ("with\nnewline\tand 'quotes'", [1]),
+        (42, [1.5, -2.0]),
+        ((1, "compound", 2.5), [[1, 2], {"a": 1}]),
+        ("unicode-ñ-键", [None, True, False]),
+        (b"bytes-key", [b"\x00\xff"]),
+    ],
+)
+def test_record_roundtrip(key, values):
+    line = serialize_record(key, values)
+    assert "\n" not in line
+    k2, v2 = parse_record(line)
+    assert k2 == key
+    assert list(v2) == list(values)
+
+
+def test_numpy_scalars_normalized():
+    line = serialize_record(np.str_("k"), [np.int64(3), np.float32(1.5)])
+    k, v = parse_record(line)
+    assert k == "k" and v == [3, 1.5]
+
+
+def test_check_serializable_rejects_objects():
+    check_serializable({"a": [1, (2, "x")]})
+    with pytest.raises(TypeError):
+        check_serializable(object())
+    with pytest.raises(TypeError):
+        check_serializable(lambda: None)
+    with pytest.raises(TypeError):
+        check_serializable({1, 2})  # sets don't round-trip (set() literal)
+
+
+def test_nonfinite_floats_roundtrip():
+    # an SGD map emitting a diverged loss must not corrupt the shuffle
+    line = serialize_record("loss", [float("inf"), float("-inf"), 1e308])
+    k, v = parse_record(line)
+    assert v[0] == float("inf") and v[1] == float("-inf")
+    k, v = parse_record(serialize_record("n", [float("nan")]))
+    assert v[0] != v[0]  # nan
+
+def test_parse_rejects_code():
+    with pytest.raises((ValueError, SyntaxError)):
+        parse_record("__import__('os').system('true')")
+    with pytest.raises((ValueError, SyntaxError)):
+        parse_record("('k', [1+2])")
+
+
+def test_interned_key_roundtrips_as_tuple():
+    key = interning.intern("a", 1)
+    k2, v2 = parse_record(serialize_record(key, [1]))
+    assert k2 == ("a", 1) and isinstance(k2, tuple)
+    sort_key(k2)  # orderable
+
+
+def test_none_key_is_legal_and_ordered():
+    check_serializable(None)
+    k, v = parse_record(serialize_record(None, [1]))
+    assert k is None
+    assert sorted([1, None, "a"], key=sort_key)[0] is None
+
+
+def test_sort_key_total_order():
+    keys = ["b", "a", 2, 1.5, True, (1, 2), (1, 1), b"z"]
+    ordered = sorted(keys, key=sort_key)
+    # stable property: numbers < strings < bytes < tuples; bool first
+    assert ordered[0] is True
+    assert ordered.index("a") < ordered.index("b")
+    assert ordered.index((1, 1)) < ordered.index((1, 2))
+
+
+# --- hashing: three implementations agree ----------------------------------
+
+def test_fnv_consistency():
+    words = ["the", "quick", "brown", "fox", "ñandú", ""]
+    encoded = [w.encode("utf-8") for w in words]
+    w_max = max(len(e) for e in encoded)
+    mat = np.zeros((len(words), max(w_max, 1)), dtype=np.uint8)
+    lengths = np.zeros((len(words),), dtype=np.int32)
+    for i, e in enumerate(encoded):
+        mat[i, : len(e)] = np.frombuffer(e, dtype=np.uint8)
+        lengths[i] = len(e)
+
+    host = np.array([hashing.fnv1a32(e) for e in encoded], dtype=np.uint32)
+    vec = hashing.fnv1a32_np(mat, lengths)
+    np.testing.assert_array_equal(host, vec)
+
+    jnp_out = np.asarray(hashing.fnv1a32_jnp(mat, lengths))
+    np.testing.assert_array_equal(host, jnp_out)
+
+
+def test_default_partitioner_range():
+    for k in ["a", 1, (1, "b"), b"raw"]:
+        p = hashing.default_partitioner(k, 15)
+        assert 0 <= p < 15
+    assert hashing.byte_sum_hash("abc", 10) == (97 + 98 + 99) % 10
+
+
+# --- merge iterator (reference utils.lua:206-271) ---------------------------
+
+def _stream(records):
+    text = io.StringIO()
+    write_records(text, records)
+    text.seek(0)
+    return lambda: records_iterator(lines_iterator(text))
+
+
+def test_merge_iterator_concatenates_equal_keys():
+    s1 = _stream([("a", [1]), ("c", [3, 3])])
+    s2 = _stream([("a", [10]), ("b", [2])])
+    s3 = _stream([("b", [20]), ("d", [4])])
+    merged = list(merge_iterator([s1, s2, s3]))
+    assert merged == [
+        ("a", [1, 10]),
+        ("b", [2, 20]),
+        ("c", [3, 3]),
+        ("d", [4]),
+    ]
+
+
+def test_merge_iterator_single_and_empty_sources():
+    s1 = _stream([("k", [1])])
+    s2 = _stream([])
+    assert list(merge_iterator([s1, s2])) == [("k", [1])]
+    assert list(merge_iterator([])) == []
+
+
+def test_merge_iterator_randomized_against_oracle():
+    rng = random.Random(7)
+    n_streams = 6
+    all_records = {}
+    streams = []
+    for _ in range(n_streams):
+        recs = {}
+        for _ in range(rng.randint(0, 40)):
+            k = rng.choice("abcdefghij") + str(rng.randint(0, 5))
+            recs.setdefault(k, []).append(rng.randint(0, 9))
+        sorted_recs = sorted(recs.items(), key=lambda kv: sort_key(kv[0]))
+        streams.append(_stream(sorted_recs))
+        for k, v in recs.items():
+            all_records.setdefault(k, []).extend(v)
+    merged = list(merge_iterator(streams))
+    assert [k for k, _ in merged] == sorted(all_records, key=sort_key)
+    for k, v in merged:
+        assert sorted(v) == sorted(all_records[k])
+
+
+def test_sorted_grouped():
+    out = sorted_grouped([("b", [1]), ("a", [2]), ("b", [3])])
+    assert out == [("a", [2]), ("b", [1, 3])]
