@@ -1,0 +1,18 @@
+"""Control plane: the reference's MongoDB role, rebuilt host-side.
+
+The reference uses MongoDB collections as a polled job board and singleton
+task document (SURVEY.md §2.11): ``<db>.task``, ``<db>.map_jobs``,
+``<db>.red_jobs``, ``<db>.errors`` (task.lua:349-352, cnn.lua:55-71).  The
+rebuild keeps the same document/collection *model* — it is a good fit for a
+dynamic job board — but backs it with in-process memory (unit tests,
+single-process mode) or a shared directory (multi-process workers), and
+strengthens the two weak points the survey calls out: claims are truly
+atomic (``find_and_modify``) and RUNNING jobs carry a lease so dead workers
+are reaped (reference has neither, task.lua:294-309 FIXMEs, SURVEY.md §5).
+"""
+
+from .docstore import MemoryDocStore, DirDocStore, connect  # noqa: F401
+from .connection import Connection  # noqa: F401
+from .task import Task  # noqa: F401
+from .job import Job  # noqa: F401
+from .persistent_table import PersistentTable  # noqa: F401

@@ -1,0 +1,101 @@
+"""Connection: docstore handle + errors channel + batched inserts.
+
+Parity with the reference's ``cnn`` class (mapreduce/cnn.lua): connect with
+auto-reconnect (cnn.lua:34-39 — moot for our in-proc/dir backends but the
+API shape stays), the ``errors`` collection as a remote log channel
+(cnn.lua:55-71), and buffered batch inserts flushed at
+``MAX_PENDING_INSERTS`` (cnn.lua:73-104, 50k in the reference).
+"""
+
+from __future__ import annotations
+
+import socket
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.constants import MAX_PENDING_INSERTS
+from . import docstore
+from .docstore import DocStore
+
+
+class Connection:
+    """A named database (collection-name prefix) over a :class:`DocStore`.
+
+    Reference: ``cnn(connstr, dbname, auth)`` (cnn.lua:106-113).  ``auth``
+    is accepted for API parity and ignored — there is no remote server.
+    """
+
+    def __init__(self, connstr: str, dbname: str,
+                 auth: Optional[Dict[str, str]] = None) -> None:
+        self.connstr = connstr
+        self.dbname = dbname
+        self.auth = auth
+        self._store: Optional[DocStore] = None
+        # pending batched inserts: coll -> list of (doc, callback)
+        self._pending: Dict[str, List[tuple]] = {}
+
+    # -- connection -----------------------------------------------------
+
+    def connect(self) -> DocStore:
+        """Reference: cnn.lua:34-39 (cached connection, auto-reconnect)."""
+        if self._store is None:
+            self._store = docstore.connect(self.connstr)
+        return self._store
+
+    def ns(self, coll: str) -> str:
+        """Namespace a collection under this db (Mongo's ``db.coll``)."""
+        return f"{self.dbname}.{coll}"
+
+    # -- errors channel ---------------------------------------------------
+    # Reference: cnn.lua:55-71; workers insert, the server drains and
+    # prints mid-poll (server.lua:219-228).
+
+    def insert_error(self, worker_name: str, msg: str) -> None:
+        self.connect().insert(self.ns("errors"),
+                              {"worker": worker_name, "msg": msg,
+                               "time": docstore.now()})
+
+    def insert_exception(self, worker_name: str, exc: BaseException) -> None:
+        msg = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+        self.insert_error(worker_name, msg)
+
+    def get_errors(self) -> List[Dict[str, Any]]:
+        return self.connect().find(self.ns("errors"))
+
+    def remove_errors(self, ids: List[str]) -> None:
+        if ids:
+            self.connect().remove(self.ns("errors"), {"_id": {"$in": ids}})
+
+    # -- batched inserts --------------------------------------------------
+    # Reference: cnn.lua:73-104 `annotate_insert`/`flush_pending_inserts`;
+    # the server uses it to bulk-create 50k job docs at a time
+    # (server.lua:316-325).
+
+    def annotate_insert(self, coll: str, doc: Dict[str, Any],
+                        callback: Optional[Callable] = None) -> None:
+        self._pending.setdefault(coll, []).append((doc, callback))
+        total = sum(len(v) for v in self._pending.values())
+        if total >= MAX_PENDING_INSERTS:
+            self.flush_pending_inserts(0)
+
+    def flush_pending_inserts(self, min_pending: int = 0) -> None:
+        total = sum(len(v) for v in self._pending.values())
+        if total <= min_pending:
+            return
+        store = self.connect()
+        for coll, entries in self._pending.items():
+            if not entries:
+                continue
+            store.insert_many(coll, [doc for doc, _ in entries])
+            for _, cb in entries:
+                if cb is not None:
+                    cb()
+        self._pending.clear()
+
+    # -- misc -------------------------------------------------------------
+
+    @staticmethod
+    def hostname() -> str:
+        """Reference: utils.get_hostname via ``hostname`` (utils.lua:72)."""
+        return socket.gethostname()

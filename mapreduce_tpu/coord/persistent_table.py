@@ -1,0 +1,126 @@
+"""Distributed singleton key/value document with optimistic concurrency.
+
+Parity with mapreduce/persistent_table.lua: a named singleton doc usable as
+shared runtime config across processes — ``set``/``update`` with a
+timestamp-guarded optimistic write (persistent_table.lua:41-74), spin
+``lock``/``unlock`` built on find-and-modify (persistent_table.lua:113-161),
+``read_only`` mode, ``drop``.  The APRIL-ANN training harness stores its
+experiment config in one of these (examples/APRIL-ANN/common.lua:227).
+
+Differences from the reference (intentional): attribute-style access is via
+``[]``/``get`` rather than metatable magic; the dirty/commit split is
+explicit (``set`` stages locally, ``update`` syncs) exactly like the
+reference's semantics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .connection import Connection
+from . import docstore
+
+
+class PersistentTable:
+    """Reference: ``persistent_table(name, {cnn_string, dbname, collection,
+    read_only})`` (persistent_table.lua:210-250)."""
+
+    SINGLETON_ID = "unique_key"  # reference pins _id (persistent_table.lua:44)
+
+    def __init__(self, name: str, connection: Connection,
+                 collection: str = "persistent_tables",
+                 read_only: bool = False) -> None:
+        self._name = name
+        self._cnn = connection
+        self._coll = connection.ns(collection)
+        self._read_only = read_only
+        self._dirty: Dict[str, Any] = {}
+        self._content: Dict[str, Any] = {}
+        self.update()
+
+    def _id(self) -> str:
+        return f"{self.SINGLETON_ID}.{self._name}"
+
+    # -- sync -------------------------------------------------------------
+
+    def update(self) -> None:
+        """Push staged writes (if any) with an optimistic timestamp guard,
+        then re-read (persistent_table.lua:41-74)."""
+        store = self._cnn.connect()
+        if self._dirty and not self._read_only:
+            remote = store.find_one(self._coll, {"_id": self._id()})
+            base_ts = (remote or {}).get("timestamp", 0)
+            fields = {k: v for k, v in self._dirty.items()}
+            n = store.update(
+                self._coll,
+                {"_id": self._id(),
+                 "$or": [{"timestamp": base_ts},
+                         {"timestamp": {"$exists": False}}]},
+                {"$set": fields, "$inc": {"timestamp": 1}},
+                upsert=(remote is None),
+            )
+            if n == 0:
+                raise RuntimeError(
+                    f"persistent_table {self._name!r}: concurrent update "
+                    "conflict (timestamp moved)")
+            self._dirty.clear()
+        doc = store.find_one(self._coll, {"_id": self._id()})
+        self._content = {k: v for k, v in (doc or {}).items()
+                         if k not in ("_id", "_lock")}
+
+    def set(self, key: str, value: Any) -> None:
+        """Stage a write; visible locally at once, remotely at update()
+        (persistent_table.lua:98-111)."""
+        if self._read_only:
+            raise RuntimeError(f"persistent_table {self._name!r} is read-only")
+        self._dirty[key] = value
+        self._content[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._content.get(key, default)
+
+    def __getitem__(self, key: str) -> Any:
+        return self._content[key]
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        self.set(key, value)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._content
+
+    def dirty(self) -> bool:
+        return bool(self._dirty)
+
+    # -- distributed lock -------------------------------------------------
+    # Reference: spin-lock via findAndModify on a `_lock` field
+    # (persistent_table.lua:113-161).
+
+    def lock(self, timeout: float = 30.0, poll: float = 0.01) -> None:
+        store = self._cnn.connect()
+        deadline = docstore.now() + timeout
+        # ensure the doc exists so find_and_modify has something to grab
+        store.update(self._coll, {"_id": self._id()},
+                     {"$set": {"_lock_init": True}}, upsert=True)
+        while True:
+            got = store.find_and_modify(
+                self._coll,
+                {"_id": self._id(),
+                 "$or": [{"_lock": False}, {"_lock": {"$exists": False}}]},
+                {"$set": {"_lock": True}})
+            if got is not None:
+                return
+            if docstore.now() > deadline:
+                raise TimeoutError(
+                    f"persistent_table {self._name!r}: lock timeout")
+            time.sleep(poll)
+
+    def unlock(self) -> None:
+        self._cnn.connect().update(self._coll, {"_id": self._id()},
+                                   {"$set": {"_lock": False}})
+
+    def drop(self) -> None:
+        """persistent_table.lua drop: delete the doc; local view empties."""
+        self._cnn.connect().remove(self._coll, {"_id": self._id()})
+        self._content.clear()
+        self._dirty.clear()

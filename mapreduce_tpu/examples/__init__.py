@@ -1,0 +1,5 @@
+"""Example user programs, mirroring the reference's examples/ tree:
+WordCount in single-module (examples/WordCount/init.lua) and split-module
+(examples/WordCount/{taskfn,...}.lua) forms, WordCountBig, the naive
+in-memory oracle (misc/naive.lua), and the distributed-SGD training
+harness (examples/APRIL-ANN/)."""

@@ -1,0 +1,62 @@
+"""WordCount, single-module form (reference examples/WordCount/init.lua:
+one module exporting every role, init.lua:47-63).
+
+``init`` takes ``{"files": [...], "num_reducers": N}``; taskfn emits one
+job per file (taskfn.lua:8-11), mapfn tokenizes on whitespace and emits
+``(word, 1)`` (mapfn.lua:4-7), partitionfn is FNV-1a mod num_reducers (the
+reference's bit32 rolling hash, init.lua:2-33), reducefn sums and declares
+the ACI flags so it doubles as combiner and unlocks the fast paths
+(reducefn.lua:10-14).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ...utils.hashing import fnv1a32
+
+_conf: Dict[str, Any] = {"files": [], "num_reducers": 15}
+#: finalfn deposits {word: count} here so in-process callers (tests, the
+#: CLI) can read the result; the reference prints to stdout instead
+#: (finalfn.lua:3-8).
+RESULT: Dict[str, int] = {}
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+
+def init(args: Any) -> None:
+    if args:
+        _conf.update(args)
+
+
+def taskfn(emit) -> None:
+    for i, path in enumerate(_conf["files"]):
+        emit(i, path)
+
+
+def mapfn(key: Any, value: str, emit) -> None:
+    with open(value, "r") as f:
+        for line in f:
+            for word in line.split():
+                emit(word, 1)
+
+
+def partitionfn(key: str) -> int:
+    return fnv1a32(key.encode("utf-8")) % _conf["num_reducers"]
+
+
+def reducefn(key: str, values: List[int]) -> int:
+    return sum(values)
+
+
+def combinerfn(key: str, values: List[int]) -> int:
+    return sum(values)
+
+
+def finalfn(pairs) -> bool:
+    RESULT.clear()
+    for key, values in pairs:
+        RESULT[key] = values[0]
+    return True

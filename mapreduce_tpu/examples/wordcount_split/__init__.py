@@ -1,0 +1,5 @@
+"""WordCount, split-module form: one module per role, like the reference's
+examples/WordCount/{taskfn,mapfn,partitionfn,reducefn,reducefn2,finalfn}.lua.
+Shared config lives in ``common.py``; every role module exposes ``init`` so
+whichever modules a task names, the config gets applied exactly once
+(server.lua:452-456 dedups inits by identity)."""

@@ -1,0 +1,11 @@
+"""Shared config + result sink for the split-module WordCount example."""
+
+from typing import Any, Dict
+
+conf: Dict[str, Any] = {"files": [], "num_reducers": 15}
+RESULT: Dict[str, int] = {}
+
+
+def init(args: Any) -> None:
+    if args:
+        conf.update(args)

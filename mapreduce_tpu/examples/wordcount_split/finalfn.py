@@ -1,0 +1,12 @@
+"""Reference examples/WordCount/finalfn.lua:3-8: print `count word` lines
+and finish.  We additionally deposit the counts in common.RESULT for
+in-process callers."""
+
+from .common import RESULT, init  # noqa: F401
+
+
+def finalfn(pairs) -> bool:
+    RESULT.clear()
+    for key, values in pairs:
+        RESULT[key] = values[0]
+    return True

@@ -1,0 +1,294 @@
+"""Server: the planner/driver loop (reference mapreduce/server.lua).
+
+Configures a task, plans map jobs from ``taskfn``, polls workers'
+completion, plans reduce jobs from the map output files, aggregates
+per-phase statistics, runs ``finalfn`` and drives the iterative ``"loop"``
+cycle with crash recovery (server.lua:417-622, call stack SURVEY.md §3.1).
+
+Differences by design: stats are computed host-side in Python (the
+reference ships server-side JavaScript into mongod, server.lua:155-183);
+expired RUNNING-job leases are reaped each poll (the reference only clears
+stale jobs on restart, server.lua:237-245).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from . import spec
+from . import storage as storage_mod
+from .coord import docstore
+from .coord.connection import Connection
+from .coord.job import map_results_prefix
+from .coord.task import Task, make_job
+from .utils.constants import (
+    STATUS, TASK_STATUS, DEFAULT_SLEEP, MAX_JOB_RETRIES,
+    MAX_TASKFN_VALUE_SIZE)
+from .utils.serialization import check_serializable, sort_key
+from .utils.iterators import merge_iterator
+
+logger = logging.getLogger("mapreduce_tpu.server")
+
+TERMINAL = [int(STATUS.WRITTEN), int(STATUS.FAILED)]
+
+
+class Server:
+    """Reference: ``server.new(connstr, dbname, auth)`` (server.lua:614-622)."""
+
+    def __init__(self, connstr: str, dbname: str,
+                 auth: Optional[Dict[str, str]] = None) -> None:
+        self.cnn = Connection(connstr, dbname, auth)
+        self.task = Task(self.cnn)
+        self.params: Dict[str, Any] = {}
+        self.configured = False
+        self.finished = False
+        self.poll_sleep = DEFAULT_SLEEP
+
+    # -- configuration (server.lua:417-460) --------------------------------
+
+    def configure(self, params: Dict[str, Any]) -> None:
+        params = dict(params)
+        backend, path = storage_mod.get_storage_from(params.get("storage"))
+        params["storage"] = f"{backend}:{path}"
+        params["path"] = path
+        spec.validate_spec(params)
+        # run task/final init once, dedup by module identity
+        # (server.lua:452-456)
+        init_args = params.get("init_args")
+        for role in ("taskfn", "finalfn"):
+            spec.load_role(params[role], role).ensure_init(init_args)
+        self.params = params
+        self.configured = True
+
+    # -- map planning (server.lua:249-276) ---------------------------------
+
+    def _remove_pending_jobs(self, coll: str) -> None:
+        """Clear non-terminal jobs (stale RUNNING/WAITING from a crashed
+        run), keeping WRITTEN/FAILED (server.lua:237-245)."""
+        self.cnn.connect().remove(
+            coll, {"status": {"$nin": TERMINAL}})
+
+    def _prepare_map(self) -> int:
+        taskfn = spec.load_role(self.params["taskfn"], "taskfn")
+        coll = self.task.map_jobs_ns()
+        self._remove_pending_jobs(coll)
+        existing = {d["_id"] for d in self.cnn.connect().find(coll)}
+        seen: Dict[str, Any] = {}
+        jobs: List[Dict[str, Any]] = []
+
+        def emit(key: Any, value: Any) -> None:
+            check_serializable(key)
+            check_serializable(value)
+            kid = str(key)
+            if kid in seen:
+                raise ValueError(
+                    f"taskfn emitted duplicate key {key!r} "
+                    "(reference dup check server.lua:256-268)")
+            seen[kid] = True
+            if len(repr(value)) > MAX_TASKFN_VALUE_SIZE:
+                raise ValueError(
+                    f"taskfn value for key {key!r} exceeds "
+                    f"{MAX_TASKFN_VALUE_SIZE} bytes (utils.lua:54)")
+            if kid not in existing:  # resume: don't recreate finished jobs
+                jobs.append(make_job(key, value))
+
+        taskfn.fn(emit)
+        self.task.insert_jobs(coll, jobs)
+        self.task.set_task_status(TASK_STATUS.MAP)
+        logger.info("map phase: %d jobs planned", len(jobs))
+        return len(jobs)
+
+    # -- completion polling (server.lua:186-234) ---------------------------
+
+    def _poll_phase(self, coll: str, phase: str) -> None:
+        """Block until every job in *coll* is WRITTEN or FAILED: reap
+        expired leases, promote over-retried BROKEN jobs to FAILED, drain
+        the errors channel, log progress."""
+        store = self.cnn.connect()
+        last_pct = -1.0
+        while True:
+            reaped = self.task.reap_expired(coll)
+            if reaped:
+                logger.warning("%s: reaped %d expired job leases", phase,
+                               reaped)
+            # BROKEN with repetitions >= cap -> FAILED (server.lua:192-206)
+            store.update(
+                coll,
+                {"status": int(STATUS.BROKEN),
+                 "repetitions": {"$gte": MAX_JOB_RETRIES}},
+                {"$set": {"status": int(STATUS.FAILED)}}, multi=True)
+            total = store.count(coll)
+            done = store.count(coll, {"status": {"$in": TERMINAL}})
+            errors = self.cnn.get_errors()
+            if errors:
+                for e in errors:
+                    logger.error("worker %s error: %s", e.get("worker"),
+                                 e.get("msg"))
+                self.cnn.remove_errors([e["_id"] for e in errors])
+            pct = 100.0 * done / max(total, 1)
+            if pct != last_pct:
+                logger.info("%s %.1f%% (%d/%d)", phase, pct, done, total)
+                last_pct = pct
+            if done >= total:
+                return
+            time.sleep(self.poll_sleep)
+
+    # -- reduce planning (server.lua:279-329) ------------------------------
+
+    def _prepare_reduce(self) -> int:
+        storage = storage_mod.router(self.params["storage"])
+        ns = map_results_prefix(self.params["path"])
+        # group map result files by partition token P<nnnnn>
+        # (server.lua:291-312)
+        rx = re.compile(re.escape(ns) + r"\.(P\d+)\.M")
+        parts: Dict[str, List[str]] = {}
+        for name in storage.list("^" + re.escape(ns) + r"\.P\d+\.M"):
+            m = rx.match(name)
+            if m:
+                parts.setdefault(m.group(1), []).append(name)
+        coll = self.task.red_jobs_ns()
+        self._remove_pending_jobs(coll)
+        existing = {d["_id"] for d in self.cnn.connect().find(coll)}
+        result_ns = self.task.red_results_ns()
+        jobs = []
+        for pkey in sorted(parts):
+            if pkey in existing:
+                continue
+            value = {"file": f"{ns}.{pkey}",
+                     "result": f"{result_ns}.{pkey}",
+                     "mappers": sorted(parts[pkey])}
+            jobs.append(make_job(pkey, value))
+        self.task.insert_jobs(coll, jobs)
+        self.task.set_task_status(TASK_STATUS.REDUCE)
+        logger.info("reduce phase: %d partitions", len(jobs))
+        return len(jobs)
+
+    # -- statistics (server.lua:155-183, 538-600) --------------------------
+
+    def _phase_stats(self, coll: str) -> Dict[str, Any]:
+        docs = self.cnn.connect().find(coll,
+                                       {"status": {"$in": TERMINAL}})
+        cpu = sum(d.get("cpu_time", 0.0) for d in docs)
+        real = sum(d.get("real_time", 0.0) for d in docs)
+        started = [d["started_time"] for d in docs if "started_time" in d]
+        written = [d["written_time"] for d in docs if "written_time" in d]
+        failed = sum(1 for d in docs if d["status"] == int(STATUS.FAILED))
+        return {
+            "count": len(docs),
+            "failed": failed,
+            "sum_cpu_time": cpu,
+            "sum_real_time": real,
+            "cluster_time": (max(written) - min(started)
+                             if started and written else 0.0),
+        }
+
+    def _compute_stats(self) -> Dict[str, Any]:
+        m = self._phase_stats(self.task.map_jobs_ns())
+        r = self._phase_stats(self.task.red_jobs_ns())
+        stats = {"map": m, "reduce": r,
+                 "cluster_time": m["cluster_time"] + r["cluster_time"],
+                 "iteration": self.task.iteration()}
+        self.task.set_fields({"stats": stats})
+        logger.info(
+            "stats: map %d jobs (%d failed) cpu %.3fs cluster %.3fs | "
+            "reduce %d jobs (%d failed) cpu %.3fs cluster %.3fs",
+            m["count"], m["failed"], m["sum_cpu_time"], m["cluster_time"],
+            r["count"], r["failed"], r["sum_cpu_time"], r["cluster_time"])
+        return stats
+
+    # -- final (server.lua:346-411) ----------------------------------------
+
+    def _result_pairs(self, storage) -> Iterator[Tuple[Any, List[Any]]]:
+        """Merged iterator over all result partition files, globally key-
+        sorted (server.lua:352-383 iterates files in sorted order; we merge
+        so finalfn sees one ordered stream)."""
+        result_ns = self.task.red_results_ns()
+        names = storage.list("^" + re.escape(result_ns) + r"\.P\d+$")
+
+        def records(name):
+            from .utils.serialization import parse_record
+            def it():
+                for line in storage.open_lines(name):
+                    yield parse_record(line)
+            return it
+
+        return merge_iterator([records(n) for n in names])
+
+    def _final(self) -> Any:
+        storage = storage_mod.router(self.params["storage"])
+        finalfn = spec.load_role(self.params["finalfn"], "finalfn")
+        reply = finalfn.fn(self._result_pairs(storage))
+        if reply not in (True, False, None, "loop"):
+            logger.warning("finalfn returned %r; expected "
+                           "True/False/None/'loop' (server.lua:387-390)",
+                           reply)
+        result_ns = self.task.red_results_ns()
+        if reply == "loop":
+            # iterate: forget job boards, keep task doc (server.lua:395-398)
+            logger.info("finalfn requested loop; iteration %d done",
+                        self.task.iteration())
+            self.cnn.connect().drop_collection(self.task.map_jobs_ns())
+            self.cnn.connect().drop_collection(self.task.red_jobs_ns())
+        else:
+            self.task.set_task_status(TASK_STATUS.FINISHED)
+            self.finished = True
+        # result files are deleted unless the user asked to keep them by
+        # returning False/None (server.lua:403-410)
+        if reply in (True, "loop"):
+            storage.remove_many(
+                storage.list("^" + re.escape(result_ns) + r"\.P\d+$"))
+        return reply
+
+    # -- the driver loop (server.lua:464-609) ------------------------------
+
+    def loop(self) -> Dict[str, Any]:
+        assert self.configured, "call configure() before loop()"
+        it = 0
+        skip_map = False
+        # crash recovery (server.lua:468-491)
+        if self.task.update():
+            st = self.task.status()
+            if st == TASK_STATUS.FINISHED:
+                self.drop_collections()
+            elif st == TASK_STATUS.REDUCE:
+                logger.warning("resuming crashed task at REDUCE "
+                               "(server.lua:475-481)")
+                # restore storage decisions from the surviving task doc
+                self.params["storage"] = self.task.tbl["storage"]
+                self.params["path"] = self.task.tbl["path"]
+                it = self.task.iteration()
+                skip_map = True
+            elif st in (TASK_STATUS.WAIT, TASK_STATUS.MAP):
+                logger.warning("resuming crashed task at %s", st.value)
+                self.params["storage"] = self.task.tbl["storage"]
+                self.params["path"] = self.task.tbl["path"]
+                it = max(self.task.iteration() - 1, 0)
+
+        while not self.finished:
+            if not skip_map:
+                it += 1
+                self.task.create_collection(TASK_STATUS.WAIT, self.params, it)
+                t0 = time.time()
+                self._prepare_map()
+                self._poll_phase(self.task.map_jobs_ns(), "map")
+                logger.info("map done in %.3fs", time.time() - t0)
+            else:
+                skip_map = False
+            t0 = time.time()
+            self._prepare_reduce()
+            self._poll_phase(self.task.red_jobs_ns(), "reduce")
+            logger.info("reduce done in %.3fs", time.time() - t0)
+            stats = self._compute_stats()
+            self._final()
+        return stats
+
+    def drop_collections(self) -> None:
+        """server_drop_collections (server.lua:331-343)."""
+        store = self.cnn.connect()
+        for coll in (self.task.task_ns(), self.task.map_jobs_ns(),
+                     self.task.red_jobs_ns(), self.cnn.ns("errors")):
+            store.drop_collection(coll)
+        self.task.tbl = {}

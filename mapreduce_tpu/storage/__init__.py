@@ -1,0 +1,24 @@
+"""Pluggable intermediate/result storage — the reference's ``fs`` layer.
+
+The reference exposes a GridFS-shaped API over three backends — GridFS,
+a shared NFS dir, and local-disk+scp "sshfs" (fs.lua:20-25) — selected by a
+storage DSL string and returned by ``fs.router`` (fs.lua:185-208).  The
+rebuild keeps the pluggable-named-blob model for the *general* path (map
+outputs, reduce results, checkpoints live here) with two backends:
+
+  * ``mem[:name]``   — in-process named byte store (the unit-test/GridFS
+    role; no external service needed, unlike the reference's tests);
+  * ``shared:PATH``  — a directory on local disk or NFS, atomic
+    tempfile+rename writes (fs.lua:80-115 file_builder semantics).
+
+The scp/"sshfs" backend has no TPU-native reason to exist: moving bytes
+between hosts is the collectives' job (SURVEY.md §2.9: "none needed:
+ICI/DCN collectives replace file movement"); ``shared`` covers the
+multi-process case.  The device engine bypasses this layer entirely —
+intermediate data stays in HBM.
+"""
+
+from .base import Storage, FileBuilder  # noqa: F401
+from .memory import MemoryStorage  # noqa: F401
+from .localdir import LocalDirStorage  # noqa: F401
+from .router import router, get_storage_from  # noqa: F401

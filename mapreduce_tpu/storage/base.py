@@ -1,0 +1,91 @@
+"""Storage interface: named text blobs with regex listing.
+
+Shape parity with the reference's GridFS-flavoured fs API: ``list`` by
+pattern (fs.lua cursor over ``ls``/GridFS listing, fs.lua:42-77), a
+*builder* that stages writes and publishes atomically on ``build``
+(GridFileBuilder / tmpfile+rename, fs.lua:80-115), ``remove_file``, and a
+per-file line iterator (utils.gridfs_lines_iterator, utils.lua:133-200).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator, List, Optional
+
+
+class FileBuilder:
+    """Write-staging handle; nothing is visible until :meth:`build`.
+
+    Reference: ``mongo.GridFileBuilder`` / fs.file_builder (fs.lua:80-115):
+    append chunks, then ``build(name)`` publishes atomically (tmpfile +
+    rename in the shared backend).
+    """
+
+    def __init__(self, storage: "Storage") -> None:
+        self._storage = storage
+        self._parts: List[str] = []
+
+    def append(self, text: str) -> None:
+        self._parts.append(text)
+
+    def write_record_line(self, line: str) -> None:
+        self.append(line)
+        self.append("\n")
+
+    def build(self, name: str) -> None:
+        """Publish the staged content as *name*, atomically."""
+        self._storage._publish(name, "".join(self._parts))
+        self._parts = []
+
+
+class Storage:
+    """Abstract named-blob store (one reference "filesystem")."""
+
+    #: DSL scheme name ("mem", "shared")
+    scheme: str = "?"
+
+    def builder(self) -> FileBuilder:
+        return FileBuilder(self)
+
+    def _publish(self, name: str, content: str) -> None:
+        raise NotImplementedError
+
+    def open_lines(self, name: str) -> Iterator[str]:
+        """Iterate the text lines of blob *name* (newline-stripped)."""
+        raise NotImplementedError
+
+    def read(self, name: str) -> str:
+        raise NotImplementedError
+
+    def write(self, name: str, content: str) -> None:
+        """Convenience: one-shot atomic publish."""
+        b = self.builder()
+        b.append(content)
+        b.build(name)
+
+    def list(self, pattern: Optional[str] = None) -> List[str]:
+        """Names matching regex *pattern* (reference matches Lua patterns
+        against GridFS filenames, e.g. ``^path/.*P.*M.*$`` server.lua:291).
+        Sorted for determinism."""
+        names = self._all_names()
+        if pattern is not None:
+            rx = re.compile(pattern)
+            names = [n for n in names if rx.search(n)]
+        return sorted(names)
+
+    def _all_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def remove(self, name: str) -> None:
+        raise NotImplementedError
+
+    def remove_many(self, names: List[str]) -> None:
+        for n in names:
+            self.remove(n)
+
+    def clear(self) -> None:
+        for n in self._all_names():
+            self.remove(n)

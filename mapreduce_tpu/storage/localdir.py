@@ -1,0 +1,69 @@
+"""Directory-backed storage: the reference's "shared" (NFS) backend.
+
+Blob name -> one file under the root; names may contain ``/`` and dots
+freely (reference names look like ``<path>/map_results.P3.M7``,
+job.lua:196-215) — they are flattened with URL-style quoting so listing is
+a flat readdir.  Writes are tempfile + ``os.rename``, the same atomic
+publish the reference uses (fs.lua:94-103).  Safe for concurrent writers
+on local disk or NFS (rename atomicity).
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.parse
+import uuid
+from typing import Iterator, List
+
+from .base import Storage
+
+
+class LocalDirStorage(Storage):
+    scheme = "shared"
+
+    #: staging subdirectory — keeps half-written files out of _all_names
+    #: (a name-marker filter would be wrong: quote() passes "~" through,
+    #: so user keys can legally contain any marker we'd pick)
+    STAGING = ".staging"
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(os.path.join(root, self.STAGING), exist_ok=True)
+
+    def _fname(self, name: str) -> str:
+        return os.path.join(self.root, urllib.parse.quote(name, safe=""))
+
+    def _publish(self, name: str, content: str) -> None:
+        tmp = os.path.join(self.root, self.STAGING,
+                           f"{os.getpid()}.{uuid.uuid4().hex[:8]}")
+        with open(tmp, "w") as f:
+            f.write(content)
+        os.rename(tmp, self._fname(name))  # same fs: atomic
+
+    def open_lines(self, name: str) -> Iterator[str]:
+        with open(self._fname(name), "r") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line:
+                    yield line
+
+    def read(self, name: str) -> str:
+        with open(self._fname(name), "r") as f:
+            return f.read()
+
+    def _all_names(self) -> List[str]:
+        out = []
+        for entry in os.listdir(self.root):
+            if entry == self.STAGING:
+                continue
+            out.append(urllib.parse.unquote(entry))
+        return out
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._fname(name))
+
+    def remove(self, name: str) -> None:
+        try:
+            os.remove(self._fname(name))
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,62 @@
+"""In-process named byte store — the GridFS role for tests and
+single-process runs (reference default backend, fs.lua:20-25), with a
+process-wide named registry so server/worker objects sharing a process
+share blobs the way reference processes share mongod's GridFS.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List
+
+from .base import Storage
+
+
+class MemoryStorage(Storage):
+    scheme = "mem"
+
+    _registry: Dict[str, "MemoryStorage"] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._blobs: Dict[str, str] = {}
+        self._lock = threading.RLock()
+
+    @classmethod
+    def named(cls, name: str) -> "MemoryStorage":
+        with cls._registry_lock:
+            if name not in cls._registry:
+                cls._registry[name] = cls()
+            return cls._registry[name]
+
+    @classmethod
+    def drop_named(cls, name: str) -> None:
+        with cls._registry_lock:
+            cls._registry.pop(name, None)
+
+    def _publish(self, name: str, content: str) -> None:
+        with self._lock:
+            self._blobs[name] = content
+
+    def open_lines(self, name: str) -> Iterator[str]:
+        with self._lock:
+            content = self._blobs[name]
+        for line in content.splitlines():
+            if line:
+                yield line
+
+    def read(self, name: str) -> str:
+        with self._lock:
+            return self._blobs[name]
+
+    def _all_names(self) -> List[str]:
+        with self._lock:
+            return list(self._blobs.keys())
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._blobs
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._blobs.pop(name, None)

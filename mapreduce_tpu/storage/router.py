@@ -1,0 +1,46 @@
+"""Storage DSL parsing + backend routing.
+
+Reference: ``utils.get_storage_from`` parses ``"gridfs|shared|sshfs[:PATH]"``
+defaulting to gridfs + os.tmpname (utils.lua:273-285), and ``fs.router``
+returns the backend handle plus builder/line-iterator factories
+(fs.lua:185-208).  Our DSL: ``"mem[:NAME]" | "shared:PATH" | "local:PATH"``
+(local = alias of shared).  There is no sshfs backend — collectives replace
+host-to-host file movement (SURVEY.md §2.9) and ``shared`` covers
+multi-process on one host/NFS.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Tuple
+
+from .base import Storage
+from .memory import MemoryStorage
+from .localdir import LocalDirStorage
+
+DEFAULT_STORAGE = "mem"
+
+
+def get_storage_from(storage: str = None) -> Tuple[str, str]:
+    """Parse the DSL string into ``(backend, path)``; defaults mirror the
+    reference's gridfs + tmpname (utils.lua:273-285)."""
+    storage = storage or DEFAULT_STORAGE
+    backend, sep, path = storage.partition(":")
+    backend = backend.strip()
+    if backend == "local":
+        backend = "shared"
+    if backend not in ("mem", "shared"):
+        raise ValueError(
+            f"unknown storage backend {backend!r} (want mem|shared|local)")
+    if not sep or not path:
+        path = ("default" if backend == "mem"
+                else tempfile.mkdtemp(prefix="mr_tpu_storage_"))
+    return backend, path
+
+
+def router(storage: str = None) -> Storage:
+    """Open the backend named by a DSL string (fs.router, fs.lua:185-208)."""
+    backend, path = get_storage_from(storage)
+    if backend == "mem":
+        return MemoryStorage.named(path)
+    return LocalDirStorage(path)

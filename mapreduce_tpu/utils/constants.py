@@ -81,6 +81,11 @@ DEFAULT_JOB_LEASE = 30.0
 #: worker heartbeat period; must be well under DEFAULT_JOB_LEASE.
 DEFAULT_HEARTBEAT = 5.0
 
+#: locality preference: after this many idle polls a worker stops holding
+#: out for its own cached map jobs and claims anything
+#: (task.lua:249-254 MAX_IDLE_COUNT).
+MAX_IDLE_COUNT = 5
+
 #: grid/file-name layout for intermediate files, mirroring the reference's
 #: "<results_ns>.P<part>.M<map_key>" convention (job.lua:196-215).
 MAP_RESULT_TEMPLATE = "{ns}.P{part}.M{mapkey}"

@@ -1,0 +1,153 @@
+"""Worker: the polling executor loop (reference mapreduce/worker.lua).
+
+Claims jobs from the task's job board, runs them under an exception shield
+that marks the job BROKEN and reports to the errors channel, backs off
+exponentially when idle, and self-terminates after too many distinct
+failures (worker.lua:42-138, call stack SURVEY.md §3.2).  New vs the
+reference: a heartbeat thread extends the RUNNING job's lease so the server
+can distinguish slow workers from dead ones (SURVEY.md §5 gap).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .coord.connection import Connection
+from .coord.job import Job
+from .coord.task import Task
+from .utils.constants import (
+    TASK_STATUS, DEFAULT_SLEEP, DEFAULT_MAX_SLEEP, DEFAULT_MAX_ITER,
+    DEFAULT_MAX_TASKS, DEFAULT_HEARTBEAT, MAX_WORKER_RETRIES)
+
+logger = logging.getLogger("mapreduce_tpu.worker")
+
+
+class Worker:
+    """Reference: ``worker.new(connstr, dbname, auth)`` (worker.lua:154-167)."""
+
+    def __init__(self, connstr: str, dbname: str,
+                 auth: Optional[Dict[str, str]] = None,
+                 name: Optional[str] = None) -> None:
+        self.cnn = Connection(connstr, dbname, auth)
+        self.task = Task(self.cnn)
+        self.name = name or f"{Connection.hostname()}-{id(self):x}"
+        self.max_iter = DEFAULT_MAX_ITER
+        self.max_sleep = DEFAULT_MAX_SLEEP
+        self.max_tasks = DEFAULT_MAX_TASKS
+        self.sleep = DEFAULT_SLEEP
+        self.heartbeat_period = DEFAULT_HEARTBEAT
+        self.jobs_done = 0
+
+    def configure(self, conf: Dict[str, Any]) -> None:
+        """worker.lua:142-148: max_iter / max_sleep / max_tasks knobs."""
+        for k in ("max_iter", "max_sleep", "max_tasks"):
+            if k in conf:
+                setattr(self, k, conf[k])
+
+    # -- one job under heartbeat ------------------------------------------
+
+    def _run_job(self, job: Job) -> None:
+        stop = threading.Event()
+
+        def beat() -> None:
+            while not stop.wait(self.heartbeat_period):
+                try:
+                    self.task.heartbeat(job.tbl)
+                except Exception:  # heartbeat must never kill the job
+                    logger.exception("heartbeat failed")
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        try:
+            job.execute()
+        finally:
+            stop.set()
+            t.join()
+
+    # -- the executor loop (worker.lua:42-105) ----------------------------
+
+    def _execute_task(self) -> bool:
+        """Work one task to completion; True if any job was executed."""
+        iter_count = 0
+        sleep = self.sleep
+        worked = False
+        failures = 0
+        while iter_count < self.max_iter:
+            job_tbl, status = self.task.take_next_job(
+                self.name, Task.tmpname())
+            if job_tbl is not None:
+                job = Job(self.cnn, job_tbl, status, self.task.tbl,
+                          self.task.jobs_ns())
+                logger.info("%s: running %s job %s", self.name,
+                            status.value, job.get_id())
+                try:
+                    self._run_job(job)
+                    if status == TASK_STATUS.MAP:
+                        self.task.note_written_map_job(job.get_id())
+                    self.jobs_done += 1
+                    worked = True
+                except Exception as exc:
+                    # xpcall shield: mark BROKEN, report, maybe give up
+                    # (worker.lua:112-138)
+                    logger.exception("%s: job %s failed", self.name,
+                                     job.get_id())
+                    job.mark_as_broken()
+                    self.cnn.insert_exception(self.name, exc)
+                    failures += 1
+                    if failures >= MAX_WORKER_RETRIES:
+                        logger.error(
+                            "%s: %d failures, giving up on task "
+                            "(worker.lua:133-137)", self.name, failures)
+                        return worked
+                iter_count = 0
+                sleep = self.sleep
+                continue
+            if status == TASK_STATUS.FINISHED:
+                return worked
+            # idle: exponential backoff (worker.lua:97-103)
+            iter_count += 1
+            time.sleep(sleep)
+            sleep = min(sleep * 1.5, self.max_sleep)
+        return worked
+
+    def execute(self) -> None:
+        """Top-level entry (worker.lua:112-138): serve up to max_tasks
+        tasks, waiting for each to appear."""
+        logger.info("worker %s starting", self.name)
+        for _ in range(self.max_tasks):
+            # wait for a task document to exist and leave WAIT
+            iter_count = 0
+            sleep = self.sleep
+            while iter_count < self.max_iter:
+                if self.task.update() and not self.task.finished():
+                    if self.task.status() != TASK_STATUS.WAIT:
+                        break
+                iter_count += 1
+                time.sleep(sleep)
+                sleep = min(sleep * 1.5, self.max_sleep)
+            else:
+                logger.info("worker %s: no task appeared, exiting", self.name)
+                return
+            self._execute_task()
+        logger.info("worker %s done (%d jobs)", self.name, self.jobs_done)
+
+
+def spawn_worker_threads(connstr: str, dbname: str, n: int,
+                         conf: Optional[Dict[str, Any]] = None,
+                         ) -> List[threading.Thread]:
+    """Run *n* workers as daemon threads in this process — the rebuild's
+    'fake cluster' for tests and the single-host deployment (the reference
+    uses N OS processes under ``screen``, test.sh:10)."""
+    threads = []
+    for i in range(n):
+        w = Worker(connstr, dbname, name=f"w{i}")
+        if conf:
+            w.configure(conf)
+        t = threading.Thread(target=w.execute, daemon=True,
+                             name=f"mr-worker-{i}")
+        t.start()
+        threads.append(t)
+    return threads

@@ -1,0 +1,76 @@
+"""Storage-layer tests across both backends (reference fs.utest,
+fs.lua:213-251, runs gridfs/shared/sshfs; our matrix is mem/shared)."""
+
+import uuid
+
+import pytest
+
+from mapreduce_tpu import storage as storage_mod
+from mapreduce_tpu.storage import (
+    LocalDirStorage, MemoryStorage, get_storage_from, router)
+
+
+@pytest.fixture(params=["mem", "shared"])
+def store(request, tmp_path):
+    if request.param == "mem":
+        return MemoryStorage()
+    return LocalDirStorage(str(tmp_path / "blobs"))
+
+
+def test_builder_publish_read(store):
+    b = store.builder()
+    b.write_record_line("('a', [1])")
+    b.write_record_line("('b', [2, 3])")
+    assert not store.exists("f1")  # nothing visible pre-build
+    b.build("f1")
+    assert store.exists("f1")
+    assert list(store.open_lines("f1")) == ["('a', [1])", "('b', [2, 3])"]
+
+
+def test_list_patterns_and_remove(store):
+    for name in ("path/map_results.P00001.M3", "path/map_results.P00002.M3",
+                 "result.P00001", "other"):
+        store.write(name, "x\n")
+    assert store.list(r"\.P\d+\.M") == [
+        "path/map_results.P00001.M3", "path/map_results.P00002.M3"]
+    assert store.list(r"^result\.P\d+$") == ["result.P00001"]
+    store.remove("other")
+    assert not store.exists("other")
+    store.remove("other")  # idempotent
+    store.clear()
+    assert store.list() == []
+
+
+def test_overwrite_is_atomic_replace(store):
+    store.write("f", "one\n")
+    store.write("f", "two\n")
+    assert store.read("f") == "two\n"
+
+
+def test_names_with_odd_characters(store):
+    # keys become file-name tokens; quoted names must round-trip
+    name = "p/map_results.P00001.Mwe%20ird'key"
+    store.write(name, "v\n")
+    assert store.exists(name)
+    assert name in store.list()
+
+
+def test_storage_dsl():
+    assert get_storage_from("mem:foo") == ("mem", "foo")
+    assert get_storage_from("shared:/tmp/x") == ("shared", "/tmp/x")
+    assert get_storage_from("local:/tmp/x") == ("shared", "/tmp/x")
+    backend, path = get_storage_from(None)
+    assert backend == "mem" and path
+    backend, path = get_storage_from("shared")
+    assert backend == "shared" and path.startswith("/")
+    with pytest.raises(ValueError):
+        get_storage_from("gridfs:/x")  # no mongo here
+
+
+def test_router_shares_mem_namespaces():
+    name = uuid.uuid4().hex
+    a = router(f"mem:{name}")
+    b = router(f"mem:{name}")
+    a.write("f", "data")
+    assert b.read("f") == "data"
+    MemoryStorage.drop_named(name)
