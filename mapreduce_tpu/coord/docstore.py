@@ -425,12 +425,18 @@ class _DirLock:
 
     def __enter__(self):
         self.tlock.acquire()
-        path = os.path.join(self.store.root, f"{self.coll}.lock")
-        fd = self.store._fds.get(self.coll)
-        if fd is None:
-            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
-            self.store._fds[self.coll] = fd
-        fcntl.flock(fd, fcntl.LOCK_EX)
+        try:
+            path = os.path.join(self.store.root, f"{self.coll}.lock")
+            fd = self.store._fds.get(self.coll)
+            if fd is None:
+                fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+                self.store._fds[self.coll] = fd
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except BaseException:
+            # never leave the thread lock held on a failed acquire —
+            # that would deadlock every later op on this collection
+            self.tlock.release()
+            raise
         self.fd = fd
         return self
 

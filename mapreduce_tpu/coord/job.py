@@ -29,7 +29,7 @@ from typing import Any, Callable, Dict, List, Optional
 from .. import spec
 from ..utils.constants import (
     STATUS, TASK_STATUS, MAX_MAP_RESULT, MAP_RESULT_TEMPLATE)
-from ..utils.iterators import merge_iterator, sorted_grouped
+from ..utils.iterators import merge_iterator
 from ..utils.serialization import (
     serialize_record, sort_key, check_serializable)
 from .. import storage as storage_mod

@@ -1,0 +1,13 @@
+"""The device MapReduce engine: map/shuffle/reduce as ONE compiled SPMD
+program over a :class:`jax.sharding.Mesh`.
+
+This is the data plane the whole rebuild exists for (SURVEY.md §7 "design
+inversion"): where the reference moves serialized text through files and a
+polled job board, the engine runs per-shard map + local segmented combine,
+hash-partitions, exchanges records with ``all_to_all`` over ICI, and
+segment-reduces each partition — all inside one jit, nothing leaving HBM
+until the final (small) aggregated result.
+"""
+
+from .device_engine import DeviceEngine, EngineConfig, DeviceResult  # noqa: F401
+from .wordcount import DeviceWordCount  # noqa: F401

@@ -1,0 +1,108 @@
+"""Device WordCount: the end-to-end "aha" slice (SURVEY.md §7 step 4).
+
+The reference's flagship workload — Europarl word-count, 197 splits, its
+whole performance story (README.md:40-113, BASELINE.md) — runs here as one
+SPMD program: on-device tokenization + hashing (ops/tokenize.py), local
+segmented combine, hash-partition + all_to_all, segmented count reduce,
+then host-side materialisation of the unique words by slicing the original
+bytes at one representative occurrence per hash.  The host never loops
+over tokens; it only loops over *unique words* (the vocabulary, thousands
+of times smaller than the corpus).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from jax.sharding import Mesh
+
+from ..ops.segmented import compact
+from ..ops.tokenize import tokenize_hash, shard_text
+from .device_engine import DeviceEngine, EngineConfig
+
+
+def _wordcount_map_fn(token_capacity: int):
+    """map_fn: one padded byte chunk -> (hash-keys, count=1, payload) with
+    payload = (global_chunk_index, start_offset, length) so the host can
+    slice the word's bytes back out."""
+    import jax.numpy as jnp
+
+    def map_fn(chunk, chunk_index):
+        toks = tokenize_hash(chunk)
+        # (broadcasted add, not full_like: the fill value is an
+        # axis-varying tracer under shard_map)
+        idx_lane = jnp.zeros_like(toks.start) + chunk_index
+        pos_payload = jnp.stack([idx_lane, toks.start, toks.length], axis=-1)
+        (keys, payload), valid, n = compact(
+            toks.is_end, token_capacity, toks.keys, pos_payload)
+        values = valid.astype(jnp.int32)
+        overflow = jnp.maximum(n - token_capacity, 0)
+        return keys, values, payload, valid, overflow
+
+    return map_fn
+
+
+class DeviceWordCount:
+    """Count words of a text corpus on a TPU mesh.
+
+    ``chunk_len`` is the static per-chunk byte length; capacities default
+    to values sized for natural-language vocabularies and are doubled
+    automatically on overflow (DeviceEngine.run).
+    """
+
+    def __init__(self, mesh: Mesh, chunk_len: int = 1 << 20,
+                 config: Optional[EngineConfig] = None) -> None:
+        self.mesh = mesh
+        self.chunk_len = chunk_len
+        self.config = config or EngineConfig(
+            local_capacity=1 << 17, exchange_capacity=1 << 15,
+            out_capacity=1 << 17)
+        self._engines: Dict[int, DeviceEngine] = {}
+
+    def _engine_for(self, padded_len: int) -> DeviceEngine:
+        """One engine per padded chunk length.  token_capacity is L//2+1 —
+        a whitespace-separated chunk of L bytes holds at most (L+1)//2
+        words, so token compaction can never overflow (the remaining
+        capacities still grow on overflow via DeviceEngine.run)."""
+        if padded_len not in self._engines:
+            self._engines[padded_len] = DeviceEngine(
+                self.mesh, _wordcount_map_fn(padded_len // 2 + 1),
+                self.config)
+        return self._engines[padded_len]
+
+    @property
+    def engine(self) -> DeviceEngine:
+        """Most recently used engine (exposed for inspection/benchmarks)."""
+        return next(reversed(self._engines.values())) if self._engines \
+            else self._engine_for(self.chunk_len)
+
+    def count_bytes(self, data: bytes) -> Dict[bytes, int]:
+        """Count whitespace-separated words of *data* (the user surface:
+        same answer as examples/naive.wordcount on the same bytes)."""
+        n_chunks = max(1, -(-len(data) // self.chunk_len))
+        # round chunks up to a mesh multiple so every device participates
+        n_dev = self.mesh.shape["data"]
+        n_chunks = -(-n_chunks // n_dev) * n_dev
+        chunks, L = shard_text(data, n_chunks, pad_multiple=128)
+        result = self._engine_for(L).run(chunks)
+        if result.overflow:
+            raise RuntimeError(
+                f"wordcount overflowed capacities by {result.overflow} "
+                "rows even after retries; raise EngineConfig capacities")
+        counts: Dict[bytes, int] = {}
+        P_, C = result.valid.shape
+        for p in range(P_):
+            live = np.nonzero(result.valid[p])[0]
+            pay = result.payload[p]
+            vals = result.values[p]
+            for i in live:
+                ci, start, length = pay[i]
+                word = bytes(chunks[ci, start:start + length])
+                counts[word] = counts.get(word, 0) + int(vals[i])
+        return counts
+
+    def count_files(self, paths) -> Dict[bytes, int]:
+        blob = b"\n".join(open(p, "rb").read() for p in paths)
+        return self.count_bytes(blob)

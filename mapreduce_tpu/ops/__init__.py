@@ -1,0 +1,13 @@
+"""Device-side primitive ops for the TPU data plane.
+
+These replace the reference's per-record host work — Lua-table emits,
+``table.sort`` on keys (job.lua:194), heap-based k-way merge
+(utils.lua:206-271) — with batched, statically-shaped XLA programs:
+segmented sort/reduce over hashed keys, and a byte-stream tokenizer+hasher
+that turns raw text into (hash, payload) records without any host loop.
+All shapes are static and padding is explicit (valid masks), keeping
+everything jit/shard_map-compatible (SURVEY.md §7 hard part (a)).
+"""
+
+from .segmented import combine_by_key, compact, sort_by_key  # noqa: F401
+from .tokenize import tokenize_hash, WORD_HASH_LANES  # noqa: F401

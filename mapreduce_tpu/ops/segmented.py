@@ -1,0 +1,118 @@
+"""Segmented (sort-based) combine/reduce over hashed keys.
+
+This is the device replacement for the reference's two aggregation sites:
+the map-side combiner (job.lua:196-215: sort keys, fold each key's value
+list) and the reduce-side k-way merge + fold (utils.lua:206-271 +
+job.lua:264-284).  On an accelerator both become one pattern: sort records
+by key, find segment boundaries, ``segment_<op>`` the values, gather one
+representative payload per segment.  Keys are 64-bit hashes carried as two
+uint32 lanes (TPUs have no native 64-bit int path worth using here).
+
+Everything is fixed-shape: inputs carry a ``valid`` mask, outputs are
+``capacity``-padded with a count of live rows; callers detect overflow by
+``n_unique > capacity`` and may re-run with a larger capacity (the
+"capacity-bounded with overflow" answer to dynamic shapes on a static-shape
+compiler, SURVEY.md §7(a)).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# value-reduction monoids supported on-device.  The reference's ACI-flagged
+# reducers (reducefn.lua:10-14) are exactly the fns with a well-defined
+# monoid; non-ACI reducers stay on the host general path.
+REDUCE_OPS = ("sum", "min", "max")
+
+
+class Combined(NamedTuple):
+    keys: jax.Array      # [capacity, 2] uint32, unique, ascending
+    values: jax.Array    # [capacity, ...] reduced values
+    payload: jax.Array   # [capacity, P] one representative payload per key
+    valid: jax.Array     # [capacity] bool
+    n_unique: jax.Array  # [] int32 — may exceed capacity: overflow signal
+
+
+def compact(mask: jax.Array, capacity: int, *arrays: jax.Array):
+    """Gather the rows where *mask* is set into a dense ``[capacity]``
+    prefix via a cumsum-scatter (O(N), no sort) — how sparse per-position
+    results (e.g. one token per word-end byte) become dense record batches.
+
+    Returns ``(packed_arrays, valid, n)``; ``n > capacity`` == overflow
+    (rows beyond capacity are dropped, caller must check).
+    """
+    idx = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    idx = jnp.where(mask, idx, capacity)  # masked-off rows -> dropped
+    outs = []
+    for a in arrays:
+        buf = jnp.zeros((capacity,) + a.shape[1:], dtype=a.dtype)
+        outs.append(buf.at[idx].set(a, mode="drop"))
+    n = mask.sum().astype(jnp.int32)
+    valid = jnp.arange(capacity) < n
+    return tuple(outs), valid, n
+
+
+def sort_by_key(keys: jax.Array, *arrays: jax.Array,
+                valid: Optional[jax.Array] = None) -> Tuple[jax.Array, ...]:
+    """Sort rows by 64-bit key (hi, lo lanes), invalid rows last.
+
+    Returns ``(keys, *arrays, valid)`` all re-ordered.  Uses a single
+    lexicographic sort — XLA lowers this to its tuned on-device sort.
+    """
+    n = keys.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), dtype=bool)
+    # lexsort: LAST key is primary -> order (lo, hi, ~valid)
+    order = jnp.lexsort((keys[:, 1], keys[:, 0], ~valid))
+    return tuple([keys[order]] + [a[order] for a in arrays] + [valid[order]])
+
+
+def _segment_starts(keys: jax.Array, valid: jax.Array) -> jax.Array:
+    """Boolean flag per row: first row of a new key segment (rows sorted,
+    invalid rows at the end are never starts)."""
+    prev_hi = jnp.concatenate([keys[:1, 0] ^ jnp.uint32(1), keys[:-1, 0]])
+    prev_lo = jnp.concatenate([keys[:1, 1], keys[:-1, 1]])
+    changed = (keys[:, 0] != prev_hi) | (keys[:, 1] != prev_lo)
+    changed = changed.at[0].set(True)
+    return changed & valid
+
+
+def combine_by_key(keys: jax.Array, values: jax.Array, payload: jax.Array,
+                   valid: jax.Array, capacity: int,
+                   op: str = "sum") -> Combined:
+    """Group-by-key reduction: the device combiner/reducer.
+
+    ``keys``: [N, 2] uint32; ``values``: [N] or [N, D]; ``payload``:
+    [N, P] int32 (representative metadata, e.g. where the word's bytes
+    live); ``valid``: [N] bool.  Output is capacity-padded and key-sorted.
+    """
+    if op not in REDUCE_OPS:
+        raise ValueError(f"op must be one of {REDUCE_OPS}, got {op!r}")
+    keys, values, payload, valid = sort_by_key(keys, values, payload,
+                                               valid=valid)
+    starts = _segment_starts(keys, valid)
+    seg = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    n_unique = seg[-1] + jnp.int32(1)
+    n_unique = jnp.where(valid.any(), n_unique, jnp.int32(0))
+    # invalid rows -> out-of-range segment, dropped by the scatter
+    seg = jnp.where(valid, seg, capacity)
+
+    if op == "sum":
+        red = jax.ops.segment_sum(values, seg, num_segments=capacity)
+    elif op == "min":
+        red = jax.ops.segment_min(values, seg, num_segments=capacity)
+    else:
+        red = jax.ops.segment_max(values, seg, num_segments=capacity)
+
+    out_keys = jnp.zeros((capacity, 2), dtype=jnp.uint32)
+    out_keys = out_keys.at[seg].set(keys, mode="drop")
+    # any row of a segment is a valid representative (same key == same
+    # record identity), so last-writer-wins is fine
+    out_payload = jnp.zeros((capacity,) + payload.shape[1:],
+                            dtype=payload.dtype)
+    out_payload = out_payload.at[seg].set(payload, mode="drop")
+    out_valid = jnp.arange(capacity) < jnp.minimum(n_unique, capacity)
+    return Combined(out_keys, red, out_payload, out_valid, n_unique)

@@ -1,0 +1,154 @@
+"""On-device byte-stream tokenizer + word hasher.
+
+The reference's map hot loop is a Lua ``gmatch("[^%s]+")`` per line with a
+table-insert per token (examples/WordCount/mapfn.lua:4-7, job.lua:77-97).
+The TPU-native version never materialises tokens: the raw UTF-8 bytes go to
+the device as one ``[L] uint8`` array and a data-parallel pass computes,
+per byte position,
+
+  * whether a word ends there, and
+  * the rolling 64-bit hash (two independent 32-bit polynomial lanes) of
+    the word ending there, plus where its bytes start,
+
+using an associative scan over affine maps — the standard trick for
+sequential recurrences on parallel hardware: the rolling-hash step
+``h_i = a*h_{i-1} + (b_i+1)`` is the affine map ``h -> m*h + c`` with
+``(m, c) = (a, b_i+1)`` on word bytes and ``(0, 0)`` on separators (which
+also performs the reset).  ``lax.associative_scan`` composes the maps in
+O(log L) depth; the composed ``c`` lane at each position IS the hash of
+the word-prefix ending there.
+
+Note: FNV-1a itself (utils/hashing.py, the partition-hash parity fn) is
+*not* scan-decomposable (xor-then-multiply is non-affine), so the device
+path uses polynomial hashing.  Device and host paths agree because the
+host twin here (`word_hashes_host`) implements the identical polynomial.
+
+Hash equality stands in for string equality (64 bits: collision odds for a
+1M-word vocabulary are ~3e-8); the final strings are materialised on the
+host by slicing the original bytes at one representative (start, length)
+per unique hash — the "hash on device, dictionary on host" answer to
+string keys on a numeric accelerator (SURVEY.md §7 hard part (b)).
+
+Whitespace = ASCII {space, \\t, \\n, \\r, \\f, \\v}, matching Python's
+``str.split()`` on ASCII text (the reference's Lua ``%s`` class,
+mapfn.lua:4-7); multi-byte UTF-8 sequences are treated as word bytes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: polynomial multipliers for the two 32-bit hash lanes (odd constants:
+#: FNV prime and a Murmur3 finalizer constant)
+HASH_A1 = 16777619
+HASH_A2 = 0x85EBCA6B
+WORD_HASH_LANES = 2
+
+_WS = (32, 9, 10, 13, 12, 11)
+
+
+class TokenStream(NamedTuple):
+    """Per-byte-position token info (fixed shape [L])."""
+
+    is_end: jax.Array   # [L] bool — a word's last byte is here
+    keys: jax.Array     # [L, 2] uint32 — hash lanes of the word ending here
+    start: jax.Array    # [L] int32 — byte offset where that word starts
+    length: jax.Array   # [L] int32 — word length in bytes
+
+
+def _is_space(b: jax.Array) -> jax.Array:
+    m = b == jnp.uint8(_WS[0])
+    for w in _WS[1:]:
+        m = m | (b == jnp.uint8(w))
+    return m
+
+
+def _affine_scan(m: jax.Array, c: jax.Array) -> jax.Array:
+    """Inclusive scan of affine maps h->m*h+c; returns the composed c lane
+    (== h at each position, with h before the sequence = 0)."""
+
+    def combine(left, right):
+        ml, cl = left
+        mr, cr = right
+        return ml * mr, cl * mr + cr
+
+    _, c_out = jax.lax.associative_scan(combine, (m, c))
+    return c_out
+
+
+def tokenize_hash(chunk: jax.Array) -> TokenStream:
+    """Tokenize one padded byte chunk ``[L] uint8`` entirely on-device."""
+    L = chunk.shape[0]
+    b32 = chunk.astype(jnp.uint32)
+    space = _is_space(chunk)
+    word = ~space
+
+    # word ends: word byte whose successor is a separator (or the chunk end)
+    next_space = jnp.concatenate([space[1:], jnp.ones((1,), bool)])
+    is_end = word & next_space
+    # word starts: word byte whose predecessor is a separator (or position 0)
+    prev_space = jnp.concatenate([jnp.ones((1,), bool), space[:-1]])
+    is_start = word & prev_space
+
+    # two independent polynomial hash lanes via one affine scan each
+    keys = []
+    for a in (HASH_A1, HASH_A2):
+        m = jnp.where(word, jnp.uint32(a), jnp.uint32(0))
+        c = jnp.where(word, b32 + jnp.uint32(1), jnp.uint32(0))
+        keys.append(_affine_scan(m, c))
+    keys = jnp.stack(keys, axis=-1)
+
+    # start offset: running max of (position where a word starts, else -1),
+    # reset implicitly because separators never read it
+    pos = jnp.arange(L, dtype=jnp.int32)
+    start_marks = jnp.where(is_start, pos, jnp.int32(-1))
+    start = jax.lax.associative_scan(jnp.maximum, start_marks)
+    length = pos - start + 1
+    return TokenStream(is_end=is_end, keys=keys, start=start, length=length)
+
+
+# --- host twin (oracle + final key materialisation) ------------------------
+
+def word_hashes_host(text: bytes) -> dict:
+    """Pure-Python twin of :func:`tokenize_hash`: {word_bytes: (h1, h2)}.
+    Used by tests as the oracle and available for host-side fallback."""
+    out = {}
+    for w in text.split():
+        h1 = h2 = 0
+        for byte in w:
+            h1 = (h1 * HASH_A1 + byte + 1) & 0xFFFFFFFF
+            h2 = (h2 * HASH_A2 + byte + 1) & 0xFFFFFFFF
+        out[w] = (h1, h2)
+    return out
+
+
+def shard_text(data: bytes, num_shards: int,
+               pad_multiple: int = 128) -> Tuple[np.ndarray, int]:
+    """Host prep: split a text blob into ``num_shards`` roughly equal byte
+    chunks on whitespace boundaries, space-padded to one common static
+    length (multiple of *pad_multiple* for TPU lane alignment).
+
+    Returns ``(chunks [S, L] uint8, L)``.  Splitting only at whitespace
+    keeps every word intact inside exactly one shard — the same invariant
+    the reference gets from line-aligned input splits (README.md:43-45).
+    """
+    n = len(data)
+    bounds = [0]
+    for s in range(1, num_shards):
+        cut = min(n, s * n // num_shards)
+        while cut < n and data[cut:cut + 1] not in (b" ", b"\t", b"\n",
+                                                    b"\r", b"\x0b", b"\x0c"):
+            cut += 1
+        bounds.append(cut)
+    bounds.append(n)
+    parts = [data[bounds[i]:bounds[i + 1]] for i in range(num_shards)]
+    L = max(1, max(len(p) for p in parts))
+    L = ((L + pad_multiple - 1) // pad_multiple) * pad_multiple
+    arr = np.full((num_shards, L), ord(" "), dtype=np.uint8)
+    for i, p in enumerate(parts):
+        arr[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
+    return arr, L

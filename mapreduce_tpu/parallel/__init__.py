@@ -1,0 +1,10 @@
+"""Mesh + collective layer: the TPU replacement for the reference's entire
+communication fabric (MongoDB job board + GridFS/NFS/scp file movement,
+SURVEY.md §2.11).  Intermediate data never leaves HBM: hash-partitioned
+records move between devices as one ``all_to_all`` inside the compiled
+program, over ICI — the design inversion BASELINE.json calls the north
+star ("replace polled shared state with compiled collectives").
+"""
+
+from .mesh import make_mesh, local_data_axis_size  # noqa: F401
+from .shuffle import partition_exchange, Exchanged  # noqa: F401

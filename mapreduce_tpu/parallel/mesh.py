@@ -1,0 +1,44 @@
+"""Device-mesh construction helpers.
+
+One place decides how devices become a named :class:`jax.sharding.Mesh`.
+Axis conventions across the framework:
+
+  * ``"data"`` — the map/shuffle data-parallel axis (shards of input,
+    one reduce partition per device position);
+  * ``"model"`` — reserved for tensor-parallel model state in the
+    training path (models/), size 1 unless requested.
+
+On multi-host slices, callers pass ``jax.devices()`` (all global devices)
+and the mesh spans hosts; ICI carries the collectives within a slice and
+DCN across slices — axis order puts ``"data"`` innermost so its
+collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``(model, data)`` mesh from the first ``n_model*n_data``
+    devices (default: all).  ``data`` is the fastest-varying (innermost)
+    axis so neighbouring mesh positions are ICI neighbours."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_model
+    need = n_model * n_data
+    if need > len(devices):
+        raise ValueError(
+            f"mesh wants {need} devices, only {len(devices)} available")
+    grid = np.array(devices[:need]).reshape(n_model, n_data)
+    return Mesh(grid, ("model", "data"))
+
+
+def local_data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape["data"]

@@ -1,0 +1,81 @@
+"""The shuffle: hash-partition + capacity-bounded ``all_to_all``.
+
+This is the device-native replacement for the reference's entire shuffle
+machinery — partitionfn hashing on the host (partitionfn.lua:2-15),
+per-partition intermediate *files* (job.lua:196-221), reduce jobs pulling
+those files over GridFS/NFS/scp (fs.lua:141-181), and the k-way merge
+(utils.lua:206-271).  Here a record's partition is ``key_hi mod P``; every
+device packs its records into a ``[P, C, lanes]`` send buffer and one
+``lax.all_to_all`` over the mesh axis moves partition *p*'s records to
+device *p* over ICI, inside the compiled program.
+
+Static shapes on a dynamic problem (SURVEY.md §7 hard part (a)): the
+per-destination capacity ``C`` is fixed; rows beyond it are counted in
+``overflow`` (never silently lost — callers check and re-run with a larger
+C).  Packing is scatter-based (O(N)), not sort-based.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Exchanged(NamedTuple):
+    keys: jax.Array      # [P*C, 2] uint32 — records received by this device
+    values: jax.Array    # [P*C, ...]
+    payload: jax.Array   # [P*C, Q] int32
+    valid: jax.Array     # [P*C] bool
+    overflow: jax.Array  # [] int32 — rows dropped on the SEND side here
+
+
+def partition_exchange(keys: jax.Array, values: jax.Array,
+                       payload: jax.Array, valid: jax.Array,
+                       axis_name: str, capacity: int) -> Exchanged:
+    """Exchange records so device ``p`` ends up with every record whose
+    ``key_hi % P == p``.  Must run inside ``shard_map`` over *axis_name*.
+
+    ``capacity`` bounds rows per (source, destination) pair.
+    """
+    P = jax.lax.psum(1, axis_name)
+    n = keys.shape[0]
+    dest = (keys[:, 0] % jnp.uint32(P)).astype(jnp.int32)
+    dest = jnp.where(valid, dest, P)  # invalid -> out-of-range, dropped
+
+    # rank of each row within its destination bucket, via one-hot cumsum:
+    # rank[i] = #{j < i : dest[j] == dest[i]}   (O(N*P) elementwise — P is
+    # the mesh size, small; avoids a sort)
+    onehot = (dest[:, None] == jnp.arange(P)[None, :]).astype(jnp.int32)
+    rank = jnp.take_along_axis(
+        jnp.cumsum(onehot, axis=0) - 1,
+        jnp.clip(dest, 0, P - 1)[:, None], axis=1)[:, 0]
+
+    counts = onehot.sum(axis=0)  # [P] rows wanted per destination
+    overflow = jnp.maximum(counts - capacity, 0).sum()
+
+    def scatter(arr, fill=0):
+        buf = jnp.full((P, capacity) + arr.shape[1:], fill, dtype=arr.dtype)
+        return buf.at[dest, rank].set(arr, mode="drop")
+
+    send_keys = scatter(keys)
+    send_vals = scatter(values)
+    send_pay = scatter(payload)
+    send_live = scatter(valid.astype(jnp.int32))
+
+    # one collective moves the whole shuffle over ICI: slot [d] of the
+    # send buffer goes to device d; slot [s] of the result came from s
+    recv_keys = jax.lax.all_to_all(send_keys, axis_name, 0, 0, tiled=False)
+    recv_vals = jax.lax.all_to_all(send_vals, axis_name, 0, 0, tiled=False)
+    recv_pay = jax.lax.all_to_all(send_pay, axis_name, 0, 0, tiled=False)
+    recv_live = jax.lax.all_to_all(send_live, axis_name, 0, 0, tiled=False)
+
+    flat = lambda a: a.reshape((P * capacity,) + a.shape[2:])
+    return Exchanged(
+        keys=flat(recv_keys),
+        values=flat(recv_vals),
+        payload=flat(recv_pay),
+        valid=flat(recv_live) == 1,
+        overflow=overflow,
+    )

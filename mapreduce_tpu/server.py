@@ -20,14 +20,13 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from . import spec
 from . import storage as storage_mod
-from .coord import docstore
 from .coord.connection import Connection
 from .coord.job import map_results_prefix
 from .coord.task import Task, make_job
 from .utils.constants import (
     STATUS, TASK_STATUS, DEFAULT_SLEEP, MAX_JOB_RETRIES,
     MAX_TASKFN_VALUE_SIZE)
-from .utils.serialization import check_serializable, sort_key
+from .utils.serialization import check_serializable
 from .utils.iterators import merge_iterator
 
 logger = logging.getLogger("mapreduce_tpu.server")
@@ -154,12 +153,14 @@ class Server:
         existing = {d["_id"] for d in self.cnn.connect().find(coll)}
         result_ns = self.task.red_results_ns()
         jobs = []
+        # NOTE: no per-job "mappers" hostname list, unlike server.lua:316-323
+        # — that existed for the scp pull; the reduce executor re-lists the
+        # shared storage by prefix instead
         for pkey in sorted(parts):
             if pkey in existing:
                 continue
             value = {"file": f"{ns}.{pkey}",
-                     "result": f"{result_ns}.{pkey}",
-                     "mappers": sorted(parts[pkey])}
+                     "result": f"{result_ns}.{pkey}"}
             jobs.append(make_job(pkey, value))
         self.task.insert_jobs(coll, jobs)
         self.task.set_task_status(TASK_STATUS.REDUCE)
