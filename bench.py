@@ -1,0 +1,103 @@
+"""Benchmark: Europarl-scale word count on the device engine.
+
+Reference headline (BASELINE.md): word-count over Europarl-v7 English —
+1,965,734 lines / 49,158,635 running words — in 47.372 s cluster time on
+4 CPU workers (reference README.md:70).  This bench runs the same-scale
+workload (a deterministic synthetic corpus with Zipf-distributed vocabulary
+matching the reference corpus' line/word counts) through the SPMD device
+engine on whatever accelerator is present and prints ONE JSON line:
+
+    {"metric": "europarl_wordcount_wall_s", "value": <seconds>,
+     "unit": "s", "vs_baseline": <47.372 / seconds>}
+
+Wall time covers the full user operation — host bytes -> device, tokenize,
+hash, combine, shuffle, reduce, and host materialisation of every unique
+word — after one untimed warmup run that also pays XLA compilation (the
+reference's numbers likewise exclude Lua/mongod startup).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_S = 47.372          # reference README.md:70, 4 workers
+N_WORDS = 49_158_635         # reference README.md:43-45
+N_LINES = 1_965_734
+VOCAB = 80_000
+WORD_W = 8                   # fixed byte width per token incl. separator
+
+
+def make_corpus(n_words: int = N_WORDS, n_lines: int = N_LINES,
+                vocab_size: int = VOCAB, seed: int = 0) -> bytes:
+    """Zipf-ish text at Europarl scale, built with vectorised numpy (no
+    Python loop over 49M tokens)."""
+    rng = np.random.default_rng(seed)
+    letters = np.frombuffer(b"abcdefghijklmnopqrstuvwxyz", dtype=np.uint8)
+    lengths = rng.integers(2, WORD_W, size=vocab_size)  # 2..7 chars
+    vocab = np.full((vocab_size, WORD_W), ord(" "), dtype=np.uint8)
+    mask = np.arange(WORD_W)[None, :] < lengths[:, None]
+    vocab[mask] = letters[rng.integers(0, 26, size=int(mask.sum()))]
+    # Zipf ranks
+    p = 1.0 / (np.arange(vocab_size) + 10.0)
+    p /= p.sum()
+    ids = rng.choice(vocab_size, size=n_words, p=p)
+    arr = vocab[ids]  # [n_words, W]
+    # newline terminators at the line cadence of the reference corpus
+    line_every = max(n_words // n_lines, 1)
+    arr[line_every - 1::line_every, WORD_W - 1] = ord("\n")
+    return arr.tobytes()
+
+
+def main() -> None:
+    t0 = time.time()
+    scale = 1.0
+    if "--smoke" in sys.argv:  # quick self-check mode
+        scale = 0.002
+    corpus = make_corpus(int(N_WORDS * scale), max(int(N_LINES * scale), 1))
+    gen_s = time.time() - t0
+
+    import jax
+    from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+    from mapreduce_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    wc = DeviceWordCount(
+        mesh, chunk_len=1 << 22,
+        config=EngineConfig(local_capacity=1 << 17,
+                            exchange_capacity=1 << 17,
+                            out_capacity=1 << 18))
+
+    print(f"# corpus ready ({len(corpus)/1e6:.0f} MB, {gen_s:.1f}s); "
+          "warmup (compile) ...", file=sys.stderr, flush=True)
+    t_w = time.time()
+    counts = wc.count_bytes(corpus)  # warmup: compiles + validates
+    print(f"# warmup done in {time.time()-t_w:.1f}s", file=sys.stderr,
+          flush=True)
+    total = sum(counts.values())
+    expected = corpus.count(b" ") + corpus.count(b"\n") \
+        - corpus.count(b"  ") * 0  # every token ends with exactly one sep
+    assert total == int(N_WORDS * scale), (total, expected)
+
+    t1 = time.time()
+    counts = wc.count_bytes(corpus)
+    wall = time.time() - t1
+
+    result = {
+        "metric": "europarl_wordcount_wall_s",
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / wall, 2),
+    }
+    print(json.dumps(result))
+    print(f"# {len(counts)} unique words, {total} total; "
+          f"devices={len(mesh.devices.flat)} "
+          f"platform={jax.devices()[0].platform}; corpus gen {gen_s:.1f}s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,187 @@
+"""Command-line launchers — the reference's L5 layer
+(execute_server.lua / execute_worker.lua, SURVEY.md §1).
+
+Forms (module names accept path form ``pkg/mod.py`` and are normalised to
+``pkg.mod`` exactly like execute_server.lua:37-39 normalises ``/`` and
+strips ``.lua``):
+
+  python -m mapreduce_tpu.cli server  CONNSTR DB TASKFN MAPFN PARTITIONFN \
+      REDUCEFN [FINALFN] [COMBINERFN] [STORAGE] [--init-args JSON]
+  python -m mapreduce_tpu.cli worker  CONNSTR DB [--workers N] [--max-iter N] \
+      [--max-sleep S] [--max-tasks N]
+  python -m mapreduce_tpu.cli wordcount FILES... [--device] — convenience
+      wrapper over the WordCount example / device engine.
+
+CONNSTR is ``mem://NAME`` (single process) or ``dir:///PATH`` (shared
+directory: start workers as separate OS processes pointing at the same
+path — the reference's N-processes-one-mongod topology, test.sh:10).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import List, Optional
+
+
+def normalize_module(name: str) -> str:
+    """execute_server.lua:37-39: path form -> module form."""
+    if name.endswith(".py"):
+        name = name[:-3]
+    return name.replace("/", ".").strip(".")
+
+
+def _add_verbosity(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="-v info, -vv debug")
+
+
+def _setup_logging(verbose: int) -> None:
+    level = (logging.WARNING, logging.INFO, logging.DEBUG)[min(verbose, 2)]
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+        stream=sys.stderr)
+
+
+def cmd_server(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(prog="mapreduce_tpu server")
+    p.add_argument("connstr")
+    p.add_argument("dbname")
+    p.add_argument("taskfn")
+    p.add_argument("mapfn")
+    p.add_argument("partitionfn")
+    p.add_argument("reducefn")
+    p.add_argument("finalfn", nargs="?", default=None)
+    p.add_argument("combinerfn", nargs="?", default=None)
+    p.add_argument("storage", nargs="?", default=None)
+    p.add_argument("--init-args", default=None,
+                   help="JSON passed to every module init()")
+    p.add_argument("--result-ns", default=None)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+
+    from .server import Server
+
+    params = {
+        "taskfn": normalize_module(args.taskfn),
+        "mapfn": normalize_module(args.mapfn),
+        "partitionfn": normalize_module(args.partitionfn),
+        "reducefn": normalize_module(args.reducefn),
+        # reference CLI defaults finalfn to an empty module; we default to
+        # the reducefn module (single-module form) then a no-op
+        "finalfn": normalize_module(args.finalfn or args.reducefn),
+        "storage": args.storage,
+    }
+    if args.combinerfn:
+        params["combinerfn"] = normalize_module(args.combinerfn)
+    if args.init_args:
+        params["init_args"] = json.loads(args.init_args)
+    if args.result_ns:
+        params["result_ns"] = args.result_ns
+    server = Server(args.connstr, args.dbname)
+    server.configure(params)
+    stats = server.loop()
+    print(json.dumps(stats, default=float))
+    return 0
+
+
+def cmd_worker(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(prog="mapreduce_tpu worker")
+    p.add_argument("connstr")
+    p.add_argument("dbname")
+    p.add_argument("--workers", type=int, default=1,
+                   help="worker threads in this process")
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--max-sleep", type=float, default=None)
+    p.add_argument("--max-tasks", type=int, default=None)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+
+    from .worker import Worker, spawn_worker_threads
+
+    conf = {k: v for k, v in (("max_iter", args.max_iter),
+                              ("max_sleep", args.max_sleep),
+                              ("max_tasks", args.max_tasks))
+            if v is not None}
+    if args.workers == 1:
+        w = Worker(args.connstr, args.dbname)
+        w.configure(conf)
+        w.execute()
+    else:
+        threads = spawn_worker_threads(args.connstr, args.dbname,
+                                       args.workers, conf=conf)
+        for t in threads:
+            t.join()
+    return 0
+
+
+def cmd_wordcount(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(prog="mapreduce_tpu wordcount")
+    p.add_argument("files", nargs="+")
+    p.add_argument("--device", action="store_true",
+                   help="use the SPMD device engine instead of the "
+                        "host job-board path")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--num-reducers", type=int, default=15)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    if args.device:
+        from .engine import DeviceWordCount
+        from .parallel import make_mesh
+
+        wc = DeviceWordCount(make_mesh())
+        counts = {k.decode("utf-8", "replace"): v
+                  for k, v in wc.count_files(args.files).items()}
+    else:
+        import uuid
+
+        from .server import Server
+        from .worker import spawn_worker_threads
+
+        connstr = f"mem://{uuid.uuid4().hex}"
+        m = "mapreduce_tpu.examples.wordcount"
+        params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
+                                 "reducefn", "finalfn")}
+        params["combinerfn"] = m
+        params["storage"] = f"mem:{uuid.uuid4().hex}"
+        params["init_args"] = {"files": args.files,
+                               "num_reducers": args.num_reducers}
+        threads = spawn_worker_threads(connstr, "wc", args.workers)
+        server = Server(connstr, "wc")
+        server.configure(params)
+        server.loop()
+        for t in threads:
+            t.join(timeout=30)
+        from .examples.wordcount import RESULT
+        counts = dict(RESULT)
+    for word in sorted(counts, key=lambda w: (-counts[w], w)):
+        print(counts[word], word)
+    return 0
+
+
+COMMANDS = {"server": cmd_server, "worker": cmd_worker,
+            "wordcount": cmd_wordcount}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd = argv[0]
+    if cmd not in COMMANDS:
+        print(f"unknown command {cmd!r}; one of {sorted(COMMANDS)}",
+              file=sys.stderr)
+        return 2
+    return COMMANDS[cmd](argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

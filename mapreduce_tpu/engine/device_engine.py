@@ -72,8 +72,9 @@ class DeviceResult(NamedTuple):
 class DeviceEngine:
     """Compile-once, run-many device MapReduce over a mesh.
 
-    ``map_fn`` must be traceable and return fixed-shape record batches;
-    ``payload_width`` is Q, ``value_shape`` the per-record value shape.
+    ``map_fn`` must be traceable and return fixed-shape record batches
+    (the payload width Q and the per-record value shape are inferred from
+    tracing ``map_fn`` once — there is nothing to declare up front).
     """
 
     def __init__(self, mesh: Mesh, map_fn: Callable,
@@ -89,16 +90,19 @@ class DeviceEngine:
     def _program(self, cfg: EngineConfig):
         map_fn = self.map_fn
 
-        def per_device(chunks: jax.Array, chunk_idx: jax.Array):
-            # chunks: [k, ...chunk_shape], chunk_idx: [k] global indices
-            def init_table(keys0, vals0, pay0, valid0):
-                return combine_by_key(keys0, vals0, pay0, valid0,
-                                      cfg.local_capacity, cfg.reduce_op)
-
+        def per_device(chunks: jax.Array, chunk_idx: jax.Array,
+                       n_real: jax.Array):
+            # chunks: [k, ...chunk_shape], chunk_idx: [k] global indices,
+            # n_real: [] count of genuine chunks — indices >= n_real are
+            # padding added to even out the mesh; their records (and any
+            # overflow they report) are masked out after map_fn
             def step(state, xs):
                 table, oflow = state
                 chunk, idx = xs
                 keys, vals, pay, valid, map_oflow = map_fn(chunk, idx)
+                live = idx < n_real
+                valid = valid & live
+                map_oflow = jnp.where(live, map_oflow, 0)
                 merged = combine_by_key(
                     jnp.concatenate([table.keys, keys]),
                     jnp.concatenate([table.values, vals]),
@@ -144,7 +148,7 @@ class DeviceEngine:
         sharded = P(AXIS)
         fn = jax.shard_map(
             per_device, mesh=self.mesh,
-            in_specs=(sharded, sharded),
+            in_specs=(sharded, sharded, P()),
             out_specs=(sharded, sharded, sharded, sharded, sharded),
         )
         return jax.jit(fn)
@@ -164,26 +168,29 @@ class DeviceEngine:
         so load stays balanced and the global index rides in the payload)."""
         S = chunks.shape[0]
         k = -(-S // self.n_dev)  # chunks per device
+        # pad chunks are all-zero; the program masks their records out via
+        # the n_real bound, so their content never matters
         padded = np.zeros((k * self.n_dev,) + chunks.shape[1:],
                           dtype=chunks.dtype)
         padded[:S] = chunks
-        if np.issubdtype(chunks.dtype, np.unsignedinteger):
-            padded[S:] = ord(" ")  # harmless pad chunk for byte inputs
         idx = np.arange(k * self.n_dev, dtype=np.int32)
         order = idx.reshape(k, self.n_dev).T.reshape(-1)
         sharding = NamedSharding(self.mesh, P(AXIS))
         dev_chunks = jax.device_put(padded[order], sharding)
         dev_idx = jax.device_put(order.astype(np.int32), sharding)
-        return dev_chunks, dev_idx
+        return dev_chunks, dev_idx, np.int32(S)
 
     def run(self, chunks: np.ndarray, max_retries: int = 3) -> DeviceResult:
         """Execute over *chunks* ([S, ...] host array, sharded over the
         mesh), growing capacities until no stage overflowed."""
         cfg = self.config
+        # input transfer does not depend on capacities: pay it once, not
+        # once per retry
+        flat_chunks, flat_idx, n_real = self._shard_inputs(chunks)
         for _ in range(max_retries + 1):
-            flat_chunks, flat_idx = self._shard_inputs(chunks)
             fn = self._get_compiled(cfg)
-            keys, vals, pay, valid, oflow = fn(flat_chunks, flat_idx)
+            keys, vals, pay, valid, oflow = fn(flat_chunks, flat_idx,
+                                               n_real)
             total_oflow = int(np.asarray(oflow).sum())
             if total_oflow == 0:
                 return DeviceResult(np.asarray(keys), np.asarray(vals),

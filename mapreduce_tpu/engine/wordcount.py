@@ -104,5 +104,8 @@ class DeviceWordCount:
         return counts
 
     def count_files(self, paths) -> Dict[bytes, int]:
-        blob = b"\n".join(open(p, "rb").read() for p in paths)
-        return self.count_bytes(blob)
+        parts = []
+        for p in paths:
+            with open(p, "rb") as f:
+                parts.append(f.read())
+        return self.count_bytes(b"\n".join(parts))

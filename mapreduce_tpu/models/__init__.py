@@ -1,0 +1,27 @@
+"""Model training on the framework — the reference's APRIL-ANN role.
+
+The reference trains an MLP by round-tripping the whole serialized model
+through GridFS every map call and every optimizer step
+(examples/APRIL-ANN/common.lua:24-39,191; SURVEY.md §3.5 "the #1 perf sin
+the TPU rebuild removes").  Here the model lives in HBM:
+
+  * :mod:`mlp` — the model family (the reference's "256 inputs 128 tanh
+    10 log_softmax" MLP, examples/APRIL-ANN/init.lua:12, generalized);
+  * :mod:`digits` — a synthetic 16x16 digit-glyph dataset standing in for
+    the reference's misc/digits.png (800 train / 200 validation patterns,
+    init.lua:82-115);
+  * :mod:`trainer` — the fused fast path: data-parallel + tensor-parallel
+    sharded train step under one jit (gradient all-reduce = the psum XLA
+    inserts for the sharded-batch mean), SGD with momentum/weight decay,
+    the reference's 1/sqrt(N) gradient smoothing option
+    (common.lua:163-166), holdout early stopping (common.lua:172-189) and
+    per-iteration checkpointing.
+
+The slow-but-general alternative — training THROUGH the MapReduce job
+board, map=grads / reduce=sum / final=step, exactly like APRIL-ANN — is
+examples/train_digits/, proving the user contract covers iterative SGD.
+"""
+
+from .mlp import MLPConfig, init_params, forward, loss_and_accuracy  # noqa: F401
+from .digits import make_digits  # noqa: F401
+from .trainer import TrainConfig, DistributedTrainer  # noqa: F401

@@ -1,0 +1,69 @@
+"""MLP model family (the reference's only model architecture).
+
+Parity target: APRIL-ANN's ``"256 inputs 128 tanh 10 log_softmax"``
+(examples/APRIL-ANN/init.lua:12) with class-NLL loss; sizes are
+configurable.  Pure-functional params (a flat dict of named arrays) so the
+framework paths can address parameters by name — the reference's map/
+reduce keys are weight-matrix *names* (common.lua:85-137) and the
+tensor-parallel sharding rules key off the same names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    """Layer sizes input->hidden...->classes; dtype is the compute dtype
+    (bfloat16 keeps the matmuls on the MXU's fast path; params stay f32)."""
+
+    sizes: Tuple[int, ...] = (256, 128, 10)
+    dtype: object = jnp.bfloat16
+
+
+def init_params(key: jax.Array, cfg: MLPConfig = MLPConfig()) -> Params:
+    """Glorot-ish init, f32 master params (names: w0/b0, w1/b1, ...)."""
+    params: Params = {}
+    for i, (n_in, n_out) in enumerate(zip(cfg.sizes[:-1], cfg.sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / (n_in + n_out))
+        params[f"w{i}"] = jax.random.normal(sub, (n_in, n_out),
+                                            jnp.float32) * scale
+        params[f"b{i}"] = jnp.zeros((n_out,), jnp.float32)
+    return params
+
+
+def forward(params: Params, x: jax.Array,
+            cfg: MLPConfig = MLPConfig()) -> jax.Array:
+    """[B, in] -> [B, classes] log-probabilities (tanh hidden layers +
+    log_softmax head, matching the reference model string)."""
+    n_layers = len(cfg.sizes) - 1
+    h = x.astype(cfg.dtype)
+    for i in range(n_layers):
+        h = h @ params[f"w{i}"].astype(cfg.dtype) \
+            + params[f"b{i}"].astype(cfg.dtype)
+        if i < n_layers - 1:
+            h = jnp.tanh(h)
+    return jax.nn.log_softmax(h.astype(jnp.float32), axis=-1)
+
+
+def nll_loss(params: Params, x: jax.Array, y: jax.Array,
+             cfg: MLPConfig = MLPConfig()) -> jax.Array:
+    """Mean class-negative-log-likelihood over the (global) batch."""
+    logp = forward(params, x, cfg)
+    return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+
+def loss_and_accuracy(params: Params, x: jax.Array, y: jax.Array,
+                      cfg: MLPConfig = MLPConfig()):
+    logp = forward(params, x, cfg)
+    loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+    acc = (logp.argmax(axis=-1) == y).mean()
+    return loss, acc

@@ -1,0 +1,193 @@
+"""The fused distributed trainer: weights in HBM, one jit per train step.
+
+This is the BASELINE.json north star made concrete — the reference's
+distributed SGD moves the *entire serialized model* through GridFS for
+every minibatch gradient and every optimizer step (SURVEY.md §3.5); here
+the parameters never leave device memory:
+
+  * **data parallelism**: the global batch is sharded over the mesh's
+    ``data`` axis; the batch-mean loss makes XLA insert the gradient
+    all-reduce (psum over ICI) — the compiled equivalent of the
+    reference's map=grads / reduce=sum cycle (common.lua:85-137);
+  * **tensor parallelism**: weight matrices are sharded over the
+    ``model`` axis Megatron-style (even layers column-split, odd layers
+    row-split); GSPMD places the activation collectives.  The reference
+    has no TP (SURVEY.md §2.10 lists it absent) — this is TPU-native
+    headroom, not parity;
+  * SGD + momentum + weight decay (the reference's optimizer knobs,
+    examples/APRIL-ANN/init.lua:14-17), optional ``1/sqrt(N)`` gradient
+    smoothing (common.lua:163-166), holdout early stopping
+    (common.lua:172-189), per-epoch checkpointing.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mlp import MLPConfig, init_params, nll_loss, loss_and_accuracy
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Reference hyperparameters (examples/APRIL-ANN/init.lua:10-20) as
+    defaults: lr .01, momentum .02, weight decay 1e-4, bunch (per-shard
+    batch) 128, 20-40 epochs."""
+
+    learning_rate: float = 0.01
+    momentum: float = 0.02
+    weight_decay: float = 1e-4
+    bunch_size: int = 128
+    max_epochs: int = 40
+    min_epochs: int = 5
+    patience: int = 8           # epochs without val improvement -> stop
+    smoothing: bool = False     # grads *= 1/sqrt(n_data) (common.lua:163-166)
+    seed: int = 1234
+
+
+def param_spec(name: str, arr: Any) -> P:
+    """Tensor-parallel layout rule by parameter name (Megatron pattern:
+    alternate column/row splits so consecutive matmuls need only one
+    collective between them)."""
+    idx = int(name[1:])
+    col = (idx % 2 == 0)
+    if name.startswith("w"):
+        return P(None, "model") if col else P("model", None)
+    if name.startswith("b"):
+        return P("model") if col else P(None)
+    return P()
+
+
+class DistributedTrainer:
+    """Train the MLP family over a ``(model, data)`` mesh."""
+
+    def __init__(self, mesh: Mesh, mlp_cfg: MLPConfig = MLPConfig(),
+                 cfg: TrainConfig = TrainConfig()) -> None:
+        self.mesh = mesh
+        self.mlp_cfg = mlp_cfg
+        self.cfg = cfg
+        self.n_data = mesh.shape["data"]
+        self.opt = optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.sgd(cfg.learning_rate, momentum=cfg.momentum),
+        )
+        self.batch_sharding = NamedSharding(mesh, P("data"))
+        self.replicated = NamedSharding(mesh, P())
+
+        grad_scale = (1.0 / np.sqrt(self.n_data)) if cfg.smoothing else 1.0
+
+        def train_step(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(
+                lambda p: nll_loss(p, x, y, self.mlp_cfg))(params)
+            if grad_scale != 1.0:
+                grads = jax.tree.map(lambda g: g * grad_scale, grads)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+        self._eval = jax.jit(
+            lambda p, x, y: loss_and_accuracy(p, x, y, self.mlp_cfg))
+
+    # -- state placement ---------------------------------------------------
+
+    def init_state(self) -> Tuple[Params, Any]:
+        key = jax.random.key(self.cfg.seed)
+        params = init_params(key, self.mlp_cfg)
+        params = {
+            name: jax.device_put(
+                arr, NamedSharding(self.mesh, param_spec(name, arr)))
+            for name, arr in params.items()
+        }
+        # opt_state leaves mirror params, so init under jit inherits the
+        # param shardings without spelling them out again
+        opt_state = jax.jit(self.opt.init)(params)
+        return params, opt_state
+
+    def place_batch(self, x: np.ndarray, y: np.ndarray):
+        return (jax.device_put(x, self.batch_sharding),
+                jax.device_put(y, self.batch_sharding))
+
+    # -- the training loop (reference server_final loop, compiled) ---------
+
+    def fit(self, x_tr: np.ndarray, y_tr: np.ndarray,
+            x_va: np.ndarray, y_va: np.ndarray,
+            checkpoint_dir: Optional[str] = None,
+            log: Optional[Callable[[str], None]] = None,
+            ) -> Dict[str, Any]:
+        """Run epochs until the holdout stops improving (the reference's
+        stopping criterion role, common.lua:193-201).  Returns history +
+        final params."""
+        cfg = self.cfg
+        params, opt_state = self.init_state()
+        global_batch = cfg.bunch_size * self.n_data
+        n = x_tr.shape[0]
+        steps = max(n // global_batch, 1)
+        rng = np.random.default_rng(cfg.seed)
+        x_va_d, y_va_d = self.place_batch(x_va, y_va)
+
+        best_val = np.inf
+        best_epoch = 0
+        history: List[Dict[str, float]] = []
+        for epoch in range(1, cfg.max_epochs + 1):
+            perm = rng.permutation(n)
+            losses = []
+            for s in range(steps):
+                sel = perm[s * global_batch:(s + 1) * global_batch]
+                if len(sel) < global_batch:  # static shapes: wrap around
+                    sel = np.concatenate([sel, perm[:global_batch - len(sel)]])
+                x, y = self.place_batch(x_tr[sel], y_tr[sel])
+                params, opt_state, loss = self._train_step(
+                    params, opt_state, x, y)
+                losses.append(loss)
+            val_loss, val_acc = self._eval(params, x_va_d, y_va_d)
+            val_loss = float(val_loss)
+            rec = {"epoch": epoch, "train_loss": float(np.mean(
+                [float(l) for l in losses])), "val_loss": val_loss,
+                "val_acc": float(val_acc)}
+            history.append(rec)
+            if log:
+                log(f"epoch {epoch}: train {rec['train_loss']:.4f} "
+                    f"val {val_loss:.4f} acc {rec['val_acc']:.3f}")
+            if val_loss < best_val - 1e-6:
+                best_val, best_epoch = val_loss, epoch
+                if checkpoint_dir:
+                    save_checkpoint(os.path.join(checkpoint_dir, "best"),
+                                    params, epoch)
+            if checkpoint_dir:  # per-iteration checkpoint (common.lua:191)
+                save_checkpoint(os.path.join(checkpoint_dir, "last"),
+                                params, epoch)
+            if (epoch >= cfg.min_epochs
+                    and epoch - best_epoch >= cfg.patience):
+                break
+        return {"params": params, "history": history,
+                "best_val_loss": best_val, "best_epoch": best_epoch,
+                "epochs_run": len(history)}
+
+
+# --- checkpointing ---------------------------------------------------------
+
+def save_checkpoint(path: str, params: Params, epoch: int) -> None:
+    """Atomic npz checkpoint (the GridFS-serialized-trainer role,
+    common.lua:24-39, minus the per-minibatch round trip)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    np.savez(tmp, epoch=np.int64(epoch),
+             **{k: np.asarray(v) for k, v in params.items()})
+    os.replace(tmp + ".npz", path + ".npz")
+
+
+def load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], int]:
+    with np.load(path + ".npz") as z:
+        params = {k: z[k] for k in z.files if k != "epoch"}
+        return params, int(z["epoch"])

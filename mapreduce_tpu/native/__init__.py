@@ -1,0 +1,142 @@
+"""ctypes binding for the native host core (mr_native.cpp), with build-on-
+demand and a pure-Python fallback.
+
+The reference's host runtime is native C++ through luamongo/APRIL-ANN
+(SURVEY.md §2.9); our host-side equivalents (batch hashing, the
+tokenizer/pre-aggregator data loader) live in mr_native.cpp.  The library
+is compiled once with g++ on first use and cached next to this file; if
+no compiler is available everything degrades to the Python twins
+(utils/hashing.py, ops/tokenize.py host path) with identical results.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger("mapreduce_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "mr_native.cpp")
+_SO = os.path.join(_HERE, "libmr_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o",
+           _SO + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("native build failed (%s); using Python fallback", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.mr_fnv1a32_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.mr_fnv1a32_batch.restype = None
+        lib.mr_tokenize_count.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.mr_tokenize_count.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def fnv1a32_batch(tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Native twin of utils.hashing.fnv1a32_np ([N, W] uint8 + lengths)."""
+    lib = get_lib()
+    tokens = np.ascontiguousarray(tokens, dtype=np.uint8)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    n, w = tokens.shape
+    if lib is None:
+        from ..utils.hashing import fnv1a32_np
+        return fnv1a32_np(tokens, lengths)
+    out = np.empty((n,), dtype=np.uint32)
+    lib.mr_fnv1a32_batch(tokens.ctypes.data, n, w, lengths.ctypes.data,
+                         out.ctypes.data)
+    return out
+
+
+def tokenize_count(data: bytes, capacity: int = 1 << 17):
+    """One-pass tokenize+aggregate: returns ``(hashes u64 [U], starts
+    [U], lengths [U], counts [U])`` for the unique words of *data*.
+    Falls back to a Python dict implementation without the library."""
+    lib = get_lib()
+    if lib is None:
+        return _tokenize_count_py(data)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    while True:
+        h = np.empty(capacity, dtype=np.uint64)
+        st = np.empty(capacity, dtype=np.int64)
+        ln = np.empty(capacity, dtype=np.int32)
+        ct = np.empty(capacity, dtype=np.int64)
+        n = lib.mr_tokenize_count(buf.ctypes.data, len(data),
+                                  h.ctypes.data, st.ctypes.data,
+                                  ln.ctypes.data, ct.ctypes.data, capacity)
+        if 0 <= n <= capacity:
+            return h[:n], st[:n], ln[:n], ct[:n]
+        capacity *= 2  # saturated (-1) or truncated (n > capacity)
+
+
+def _tokenize_count_py(data: bytes):
+    from ..ops.tokenize import HASH_A1, HASH_A2
+
+    agg: Dict[int, list] = {}
+    pos = 0
+    for word in data.split():
+        start = data.find(word, pos)
+        pos = start + len(word)
+        h1 = h2 = 0
+        for b in word:
+            h1 = (h1 * HASH_A1 + b + 1) & 0xFFFFFFFF
+            h2 = (h2 * HASH_A2 + b + 1) & 0xFFFFFFFF
+        h = (h1 << 32) | h2
+        e = agg.get(h)
+        if e is None:
+            agg[h] = [start, len(word), 1]
+        else:
+            e[2] += 1
+    n = len(agg)
+    hs = np.fromiter(agg.keys(), dtype=np.uint64, count=n)
+    st = np.fromiter((v[0] for v in agg.values()), dtype=np.int64, count=n)
+    ln = np.fromiter((v[1] for v in agg.values()), dtype=np.int32, count=n)
+    ct = np.fromiter((v[2] for v in agg.values()), dtype=np.int64, count=n)
+    return hs, st, ln, ct
+
+
+def wordcount_bytes(data: bytes) -> Dict[bytes, int]:
+    """Full host wordcount through the native core (the no-accelerator
+    twin of engine.DeviceWordCount.count_bytes)."""
+    hs, st, ln, ct = tokenize_count(data)
+    return {data[int(s):int(s) + int(l)]: int(c)
+            for s, l, c in zip(st, ln, ct)}

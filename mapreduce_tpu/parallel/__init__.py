@@ -6,5 +6,5 @@ program, over ICI — the design inversion BASELINE.json calls the north
 star ("replace polled shared state with compiled collectives").
 """
 
-from .mesh import make_mesh, local_data_axis_size  # noqa: F401
+from .mesh import make_mesh, data_axis_size  # noqa: F401
 from .shuffle import partition_exchange, Exchanged  # noqa: F401

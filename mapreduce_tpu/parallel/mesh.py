@@ -40,5 +40,6 @@ def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
     return Mesh(grid, ("model", "data"))
 
 
-def local_data_axis_size(mesh: Mesh) -> int:
+def data_axis_size(mesh: Mesh) -> int:
+    """GLOBAL size of the data axis (spans all hosts on a multi-host mesh)."""
     return mesh.shape["data"]

@@ -38,9 +38,11 @@ class Server:
     """Reference: ``server.new(connstr, dbname, auth)`` (server.lua:614-622)."""
 
     def __init__(self, connstr: str, dbname: str,
-                 auth: Optional[Dict[str, str]] = None) -> None:
+                 auth: Optional[Dict[str, str]] = None,
+                 job_lease: Optional[float] = None) -> None:
         self.cnn = Connection(connstr, dbname, auth)
-        self.task = Task(self.cnn)
+        self.task = Task(self.cnn, **(
+            {"job_lease": job_lease} if job_lease is not None else {}))
         self.params: Dict[str, Any] = {}
         self.configured = False
         self.finished = False

@@ -1,0 +1,65 @@
+"""Failure-injecting user modules for the fault-tolerance tests (the
+automated fault-path coverage the reference never had, SURVEY.md §4 item 4).
+"""
+
+from typing import Any, Dict, List
+
+from mapreduce_tpu.utils.hashing import fnv1a32
+
+conf: Dict[str, Any] = {"files": [], "num_reducers": 3}
+RESULT: Dict[str, int] = {}
+#: mutable knobs the tests poke
+FAIL_TIMES = {"n": 0}        # fail the first n map attempts (then succeed)
+ALWAYS_FAIL_KEY = {"key": None}  # this job key fails every time
+_attempts = {"count": 0}
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+
+def reset(files, num_reducers=3, fail_times=0, always_fail_key=None):
+    conf["files"] = files
+    conf["num_reducers"] = num_reducers
+    FAIL_TIMES["n"] = fail_times
+    ALWAYS_FAIL_KEY["key"] = always_fail_key
+    _attempts["count"] = 0
+    RESULT.clear()
+
+
+def init(args: Any) -> None:
+    if args:
+        conf.update(args)
+
+
+def taskfn(emit) -> None:
+    for i, path in enumerate(conf["files"]):
+        emit(i, path)
+
+
+def mapfn(key: Any, value: str, emit) -> None:
+    if ALWAYS_FAIL_KEY["key"] is not None and key == ALWAYS_FAIL_KEY["key"]:
+        raise RuntimeError(f"injected permanent failure for job {key}")
+    if _attempts["count"] < FAIL_TIMES["n"]:
+        _attempts["count"] += 1
+        raise RuntimeError(
+            f"injected transient failure #{_attempts['count']}")
+    with open(value, "r") as f:
+        for line in f:
+            for word in line.split():
+                emit(word, 1)
+
+
+def partitionfn(key: str) -> int:
+    return fnv1a32(key.encode()) % conf["num_reducers"]
+
+
+def reducefn(key: str, values: List[int]) -> int:
+    return sum(values)
+
+
+def finalfn(pairs) -> bool:
+    RESULT.clear()
+    for key, values in pairs:
+        RESULT[key] = values[0]
+    return True

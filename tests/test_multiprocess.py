@@ -1,0 +1,71 @@
+"""Multi-process coordination: worker(s) in separate OS processes talking
+to the server through the dir:// docstore and shared-dir storage — the
+reference's real deployment topology (N worker processes + one mongod,
+test.sh:10 launches workers under screen)."""
+
+import os
+import subprocess
+import sys
+import uuid
+
+import pytest
+
+from mapreduce_tpu import spec
+from mapreduce_tpu.examples import naive
+from mapreduce_tpu.server import Server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_modules():
+    spec.clear_caches()
+    yield
+    spec.clear_caches()
+
+
+def test_worker_processes_over_dir_store(tmp_path):
+    files = []
+    for i in range(4):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(f"alpha beta p{i} gamma alpha delta\n" * 10)
+        files.append(str(p))
+
+    connstr = f"dir://{tmp_path}/ctrl"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "mapreduce_tpu.cli", "worker",
+             connstr, "wcmp", "--workers", "2", "--max-iter", "400"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for _ in range(2)
+    ]
+    try:
+        m = "mapreduce_tpu.examples.wordcount"
+        params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
+                                 "reducefn", "finalfn")}
+        params["combinerfn"] = m
+        params["storage"] = f"shared:{tmp_path}/blobs"
+        params["init_args"] = {"files": files, "num_reducers": 5}
+        server = Server(connstr, "wcmp")
+        server.configure(params)
+        stats = server.loop()
+        from mapreduce_tpu.examples.wordcount import RESULT
+        assert RESULT == naive.wordcount(files)
+        assert stats["map"]["failed"] == 0
+        # the map work really happened in the child processes: this
+        # process never imported the job executor for those jobs — check
+        # via worker names recorded in the job docs
+        docs = server.cnn.connect().find(server.task.map_jobs_ns())
+        assert docs and all(d.get("worker") for d in docs)
+    finally:
+        for pr in procs:
+            try:
+                pr.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+    # workers exited cleanly once the task finished
+    assert all(pr.returncode == 0 for pr in procs), [
+        (pr.returncode, pr.stderr.read().decode()[-500:]) for pr in procs]

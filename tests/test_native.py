@@ -1,0 +1,65 @@
+"""Native-core tests: the C++ data loader must agree bit-for-bit with the
+Python twins (utils/hashing.py, ops/tokenize.py) and the naive oracle."""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu import native
+from mapreduce_tpu.ops.tokenize import word_hashes_host
+from mapreduce_tpu.utils.hashing import fnv1a32
+
+
+def test_native_builds():
+    assert native.native_available(), "g++ build of mr_native.cpp failed"
+
+
+def test_fnv_batch_matches_python():
+    words = [b"alpha", b"b", b"gamma-longer-word", b""]
+    w = max(len(x) for x in words)
+    mat = np.zeros((len(words), w), dtype=np.uint8)
+    lens = np.zeros(len(words), dtype=np.int32)
+    for i, word in enumerate(words):
+        mat[i, :len(word)] = np.frombuffer(word, dtype=np.uint8)
+        lens[i] = len(word)
+    out = native.fnv1a32_batch(mat, lens)
+    for i, word in enumerate(words):
+        assert int(out[i]) == fnv1a32(word)
+
+
+def test_tokenize_count_matches_oracle_and_device_hashes():
+    data = (b"the quick brown fox the lazy dog the end\n"
+            b"tabs\there  and\tmore the\n") * 7
+    counts = native.wordcount_bytes(data)
+    expected = {}
+    for w in data.split():
+        expected[w] = expected.get(w, 0) + 1
+    assert counts == expected
+    # hashes match the device/tokenize.py polynomial exactly
+    hs, st, ln, ct = native.tokenize_count(data)
+    host = word_hashes_host(data)
+    for h, s, l in zip(hs, st, ln):
+        word = data[int(s):int(s) + int(l)]
+        h1, h2 = host[word]
+        assert int(h) == ((h1 << 32) | h2)
+
+
+def test_tokenize_count_capacity_growth():
+    data = b" ".join(f"unique{i}".encode() for i in range(5000))
+    hs, st, ln, ct = native.tokenize_count(data, capacity=16)
+    assert len(hs) == 5000
+    assert int(ct.sum()) == 5000
+
+
+def test_tokenize_count_empty_and_spaces():
+    for data in (b"", b"   \n\t  "):
+        hs, st, ln, ct = native.tokenize_count(data)
+        assert len(hs) == 0
+
+
+def test_python_fallback_agrees():
+    data = b"a bb ccc a bb a\n"
+    fast = native.wordcount_bytes(data)
+    hs, st, ln, ct = native._tokenize_count_py(data)
+    slow = {data[int(s):int(s) + int(l)]: int(c)
+            for s, l, c in zip(st, ln, ct)}
+    assert fast == slow == {b"a": 3, b"bb": 2, b"ccc": 1}
