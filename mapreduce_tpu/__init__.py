@@ -30,6 +30,34 @@ from .utils.constants import STATUS, TASK_STATUS  # noqa: F401
 from .core import interning  # noqa: F401
 from .core.heap import Heap  # noqa: F401
 
-# heavier submodules (server/worker/engine) are imported lazily by users:
-#   from mapreduce_tpu.server import Server
-#   from mapreduce_tpu.worker import Worker
+#: the reference facade exports {worker, server, utils, tuple,
+#: persistent_table, utest} (init.lua:25-38); the heavier members resolve
+#: lazily so `import mapreduce_tpu` stays light (no jax import)
+_LAZY = {
+    "server": ".server",
+    "worker": ".worker",
+    "spec": ".spec",
+    "storage": ".storage",
+    "coord": ".coord",
+    "engine": ".engine",
+    "models": ".models",
+    "ops": ".ops",
+    "parallel": ".parallel",
+    "native": ".native",
+    "cli": ".cli",
+}
+
+#: name parity aliases: reference `tuple` module == interning,
+#: `persistent_table` lives in coord
+tuple_module = interning
+
+
+def __getattr__(name):
+    if name == "persistent_table":
+        from .coord import persistent_table as m
+        return m
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module(_LAZY[name], __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

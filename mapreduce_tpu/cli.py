@@ -166,8 +166,39 @@ def cmd_wordcount(argv: List[str]) -> int:
     return 0
 
 
+def cmd_drop(argv: List[str]) -> int:
+    """Drop a task's control-plane collections and (optionally) its
+    storage blobs — the reference's remove_results.sh (db.dropDatabase())."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu drop")
+    p.add_argument("connstr")
+    p.add_argument("dbname")
+    p.add_argument("--storage", default=None,
+                   help="also clear this storage backend")
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+
+    from .coord import docstore
+
+    store = docstore.connect(args.connstr)
+    dropped = 0
+    for coll in store.collections():
+        if coll == args.dbname or coll.startswith(args.dbname + "."):
+            store.drop_collection(coll)
+            dropped += 1
+    print(f"dropped {dropped} collections under {args.dbname!r}")
+    if args.storage:
+        from . import storage as storage_mod
+
+        st = storage_mod.router(args.storage)
+        n = len(st.list())
+        st.clear()
+        print(f"cleared {n} blobs from {args.storage!r}")
+    return 0
+
+
 COMMANDS = {"server": cmd_server, "worker": cmd_worker,
-            "wordcount": cmd_wordcount}
+            "wordcount": cmd_wordcount, "drop": cmd_drop}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -58,7 +58,8 @@ class DeviceWordCount:
         self.chunk_len = chunk_len
         self.config = config or EngineConfig(
             local_capacity=1 << 17, exchange_capacity=1 << 15,
-            out_capacity=1 << 17)
+            out_capacity=1 << 17, table_buckets=1 << 19,
+            residual_capacity=1 << 13)
         self._engines: Dict[int, DeviceEngine] = {}
 
     def _engine_for(self, padded_len: int) -> DeviceEngine:

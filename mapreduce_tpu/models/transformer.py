@@ -1,0 +1,210 @@
+"""Transformer LM family: long-context training via sequence parallelism.
+
+Beyond-parity model family (the reference's only model is the APRIL-ANN
+MLP; the brief makes long context + distributed first-class).  The whole
+forward/backward runs inside one ``shard_map`` over the ``(model, data)``
+mesh:
+
+  * ``data`` axis = SEQUENCE (context) parallelism: each device holds a
+    [B, T/P, E] block; attention is exact ring attention
+    (parallel/ring.py) rotating K/V over ICI;
+  * ``model`` axis = tensor parallelism: attention heads and FFN hidden
+    are head-/column-sharded, with one psum after each row-sharded
+    projection (Megatron pattern), and the vocabulary is column-sharded
+    with a psum/pmax-based cross-entropy so full logits never
+    materialise.
+
+Everything is bf16 matmuls on the MXU with f32 accumulators/params.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring import ring_attention
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256          # byte-level by default
+    embed: int = 128
+    n_layers: int = 2
+    n_heads: int = 8
+    head_dim: int = 16
+    ffn: int = 512
+    dtype: Any = jnp.bfloat16
+
+    def validate(self, n_model: int) -> None:
+        assert self.n_heads % n_model == 0, "heads must split over model axis"
+        assert self.ffn % n_model == 0
+        assert self.vocab % n_model == 0
+
+
+def init_transformer(key: jax.Array, cfg: TransformerConfig) -> Params:
+    """Flat named params (names drive the tensor-parallel layout rules)."""
+    E, H, D, F, V = (cfg.embed, cfg.n_heads, cfg.head_dim, cfg.ffn,
+                     cfg.vocab)
+    params: Params = {}
+
+    def norm(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)
+
+    keys = jax.random.split(key, 2 + 6 * cfg.n_layers)
+    params["embed"] = norm(keys[0], (V, E), 1.0) * 0.02 / 0.02
+    params["unembed"] = norm(keys[1], (E, V), E)
+    for i in range(cfg.n_layers):
+        k0 = 2 + 6 * i
+        params[f"L{i}.ln1_scale"] = jnp.ones((E,), jnp.float32)
+        params[f"L{i}.ln2_scale"] = jnp.ones((E,), jnp.float32)
+        params[f"L{i}.wqkv"] = norm(keys[k0], (E, 3, H * D), E)
+        params[f"L{i}.wo"] = norm(keys[k0 + 1], (H * D, E), H * D)
+        params[f"L{i}.w_in"] = norm(keys[k0 + 2], (E, F), E)
+        params[f"L{i}.w_out"] = norm(keys[k0 + 3], (F, E), F)
+    return params
+
+
+def transformer_param_spec(name: str) -> P:
+    """Tensor-parallel placement by name: head/column-sharded projections,
+    row-sharded outputs, replicated norms/embeddings."""
+    if name.endswith((".wqkv", ".w_in")):
+        return P(None, None, "model") if name.endswith("wqkv") \
+            else P(None, "model")
+    if name.endswith((".wo", ".w_out")):
+        return P("model", None)
+    if name == "unembed":
+        return P(None, "model")
+    return P()
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def forward_local(params: Params, tokens: jax.Array,
+                  cfg: TransformerConfig, n_model: int,
+                  data_axis: str = "data", model_axis: str = "model"):
+    """Local-block forward INSIDE shard_map: ``tokens`` [B, T_local]
+    int32; returns hidden states [B, T_local, E] (f32).  Params arrive
+    already sliced by transformer_param_spec."""
+    H_loc = cfg.n_heads // n_model
+    D = cfg.head_dim
+    x = params["embed"][tokens].astype(cfg.dtype)  # [B, T, E]
+    for i in range(cfg.n_layers):
+        h = _rmsnorm(x, params[f"L{i}.ln1_scale"].astype(cfg.dtype))
+        qkv = jnp.einsum("bte,ecf->btcf", h,
+                         params[f"L{i}.wqkv"].astype(cfg.dtype))
+        q, k, v = [qkv[:, :, j].reshape(*qkv.shape[:2], H_loc, D)
+                   for j in range(3)]
+        attn = ring_attention(q.astype(jnp.float32),
+                              k.astype(jnp.float32),
+                              v.astype(jnp.float32), data_axis,
+                              causal=True).astype(cfg.dtype)
+        attn = attn.reshape(*attn.shape[:2], H_loc * D)
+        # row-sharded output projection -> psum over the model axis
+        o = jnp.einsum("btf,fe->bte", attn,
+                       params[f"L{i}.wo"].astype(cfg.dtype))
+        o = jax.lax.psum(o.astype(jnp.float32), model_axis)
+        x = x + o.astype(cfg.dtype)
+
+        h = _rmsnorm(x, params[f"L{i}.ln2_scale"].astype(cfg.dtype))
+        u = jnp.einsum("bte,ef->btf", h,
+                       params[f"L{i}.w_in"].astype(cfg.dtype))
+        u = jax.nn.gelu(u)
+        m = jnp.einsum("btf,fe->bte", u,
+                       params[f"L{i}.w_out"].astype(cfg.dtype))
+        m = jax.lax.psum(m.astype(jnp.float32), model_axis)
+        x = x + m.astype(cfg.dtype)
+    return x.astype(jnp.float32)
+
+
+def loss_local(params: Params, tokens: jax.Array, targets: jax.Array,
+               cfg: TransformerConfig, n_model: int,
+               data_axis: str = "data", model_axis: str = "model"):
+    """Sharded next-token cross-entropy: vocabulary is column-sharded so
+    logits stay [B, T, V/n_model]; softmax statistics combine with
+    pmax/psum over the model axis; the mean combines with pmean over the
+    sequence (data) axis.  ``targets`` are the GLOBAL next tokens for this
+    block (host pre-shifts across shard boundaries)."""
+    x = forward_local(params, tokens, cfg, n_model, data_axis, model_axis)
+    w = params["unembed"]  # [E, V_loc]
+    logits = jnp.einsum("bte,ev->btv", x, w)  # f32 [B, T, V_loc]
+    # stop_gradient BEFORE pmax: the shift is gradient-neutral (logsumexp
+    # identity), pmax has no JVP rule, and as a reduction it also makes
+    # the max invariant over the model axis for vma inference
+    local_max = jax.lax.stop_gradient(logits.max(axis=-1))  # [B, T]
+    gmax = jax.lax.pmax(local_max, model_axis)
+    z = jnp.exp(logits - gmax[..., None])
+    denom = jax.lax.psum(z.sum(axis=-1), model_axis)
+    # my shard's slice of the one-hot target
+    V_loc = logits.shape[-1]
+    shard = jax.lax.axis_index(model_axis)
+    local_t = targets - shard * V_loc
+    in_shard = (local_t >= 0) & (local_t < V_loc)
+    t_logit = jnp.take_along_axis(
+        logits, jnp.clip(local_t, 0, V_loc - 1)[..., None], axis=-1)[..., 0]
+    t_logit = jax.lax.psum(jnp.where(in_shard, t_logit, 0.0), model_axis)
+    nll = (gmax + jnp.log(denom)) - t_logit
+    return jax.lax.pmean(nll.mean(), data_axis)
+
+
+class TransformerTrainer:
+    """Jit-compiled sp x tp training step over a ``(model, data)`` mesh."""
+
+    def __init__(self, mesh: Mesh, cfg: TransformerConfig,
+                 learning_rate: float = 3e-3, seed: int = 0) -> None:
+        n_model = mesh.shape["model"]
+        self.n_data = mesh.shape["data"]
+        cfg.validate(n_model)
+        self.mesh, self.cfg, self.lr = mesh, cfg, learning_rate
+        self.seed = seed
+
+        pspecs = {n: transformer_param_spec(n)
+                  for n in init_transformer(jax.random.key(0), cfg)}
+        tok_spec = P(None, "data")  # [B, T] sequence-sharded
+
+        def sharded_loss(params, tokens, targets):
+            return loss_local(params, tokens, targets, cfg, n_model)
+
+        loss_fn = jax.shard_map(
+            sharded_loss, mesh=mesh,
+            in_specs=(pspecs, tok_spec, tok_spec), out_specs=P())
+
+        def train_step(params, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets)
+            params = jax.tree.map(lambda p, g: p - learning_rate * g,
+                                  params, grads)
+            return params, loss
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._loss = jax.jit(loss_fn)
+        self._pspecs = pspecs
+
+    def init_params(self) -> Params:
+        params = init_transformer(jax.random.key(self.seed), self.cfg)
+        return {n: jax.device_put(
+                    a, NamedSharding(self.mesh, self._pspecs[n]))
+                for n, a in params.items()}
+
+    def place_batch(self, tokens: np.ndarray
+                    ) -> Tuple[jax.Array, jax.Array]:
+        """[B, T+1] host tokens -> sequence-sharded (inputs, shifted
+        targets); T must divide by the data-axis size."""
+        x, y = tokens[:, :-1], tokens[:, 1:]
+        sh = NamedSharding(self.mesh, P(None, "data"))
+        return jax.device_put(x, sh), jax.device_put(y, sh)
+
+    def step(self, params: Params, tokens: np.ndarray):
+        x, y = self.place_batch(tokens)
+        return self._train_step(params, x, y)

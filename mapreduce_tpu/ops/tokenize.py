@@ -67,17 +67,62 @@ def _is_space(b: jax.Array) -> jax.Array:
     return m
 
 
+def _affine_combine(left, right):
+    ml, cl = left
+    mr, cr = right
+    return ml * mr, cl * mr + cr
+
+
+#: inner tile width for the two-level scans.  A flat associative_scan over
+#: millions of elements unrolls into log2(L) levels of odd-shaped slices
+#: that TPU XLA compiles pathologically slowly (>10min at L=4M, measured);
+#: scanning [L/W, W] tiles along the short axis + a small cross-tile
+#: prefix pass keeps every intermediate a clean 2-D array.
+SCAN_TILE = 512
+
+
 def _affine_scan(m: jax.Array, c: jax.Array) -> jax.Array:
     """Inclusive scan of affine maps h->m*h+c; returns the composed c lane
-    (== h at each position, with h before the sequence = 0)."""
+    (== h at each position, with h before the sequence = 0).
 
-    def combine(left, right):
-        ml, cl = left
-        mr, cr = right
-        return ml * mr, cl * mr + cr
+    Two-level (tiled) formulation: within-tile inclusive scan vectorized
+    over tiles, then an exclusive cross-tile prefix of the tile totals,
+    composed back in — ``T_tile_i ∘ T_prefix_b = (Mi*Mp, Cp*Mi + Ci)``.
+    """
+    L = m.shape[0]
+    W = SCAN_TILE
+    if L % W != 0 or L <= W:
+        _, c_out = jax.lax.associative_scan(_affine_combine, (m, c))
+        return c_out
+    mb = m.reshape(L // W, W)
+    cb = c.reshape(L // W, W)
+    Mi, Ci = jax.lax.associative_scan(_affine_combine, (mb, cb), axis=1)
+    # exclusive prefix of per-tile totals (last column), shifted by one
+    Mt, Ct = Mi[:, -1], Ci[:, -1]
+    Mp, Cp = jax.lax.associative_scan(_affine_combine, (Mt, Ct))
+    one = jnp.ones((1,), m.dtype)
+    zero = jnp.zeros((1,), c.dtype)
+    Mp = jnp.concatenate([one, Mp[:-1]])
+    Cp = jnp.concatenate([zero, Cp[:-1]])
+    h = Cp[:, None] * Mi + Ci
+    return h.reshape(L)
 
-    _, c_out = jax.lax.associative_scan(combine, (m, c))
-    return c_out
+
+def _cummax_scan(x: jax.Array) -> jax.Array:
+    """Tiled inclusive running max (same rationale as _affine_scan)."""
+    L = x.shape[0]
+    W = SCAN_TILE
+    if L % W != 0 or L <= W:
+        return jax.lax.associative_scan(jnp.maximum, x)
+    xb = x.reshape(L // W, W)
+    inner = jax.lax.associative_scan(jnp.maximum, xb, axis=1)
+    totals = inner[:, -1]
+    prefix = jax.lax.associative_scan(jnp.maximum, totals)
+    lowest = jnp.full((1,), jnp.iinfo(x.dtype).min
+                      if jnp.issubdtype(x.dtype, jnp.integer) else -jnp.inf,
+                      x.dtype)
+    prefix = jnp.concatenate([lowest, prefix[:-1]])
+    return jnp.maximum(inner, prefix[:, None]).reshape(L)
 
 
 def tokenize_hash(chunk: jax.Array) -> TokenStream:
@@ -106,7 +151,7 @@ def tokenize_hash(chunk: jax.Array) -> TokenStream:
     # reset implicitly because separators never read it
     pos = jnp.arange(L, dtype=jnp.int32)
     start_marks = jnp.where(is_start, pos, jnp.int32(-1))
-    start = jax.lax.associative_scan(jnp.maximum, start_marks)
+    start = _cummax_scan(start_marks)
     length = pos - start + 1
     return TokenStream(is_end=is_end, keys=keys, start=start, length=length)
 

@@ -1,0 +1,111 @@
+"""Ring attention: sequence/context parallelism over the mesh ring.
+
+The reference has NO long-context machinery (SURVEY.md §5 "Long-context /
+sequence parallelism: absent") — its only notion of length is streaming
+file splits.  A TPU-native framework must scale sequence length across
+devices (brief requirement), and the idiomatic construct is ring
+attention: shard the sequence over the ``data`` axis, keep Q resident,
+and rotate K/V blocks around the ICI ring with ``lax.ppermute`` while
+accumulating attention in the numerically-stable online-softmax form
+(flash-attention accumulation).  Peak memory per device is O(T_local²)
+instead of O(T_global²), and the K/V transfer overlaps compute around the
+ring.
+
+Layout: inputs are the LOCAL sequence block ``[batch, t_local, heads,
+head_dim]`` inside ``shard_map`` over *axis_name*; the global sequence is
+the concatenation over mesh positions, in axis order.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One (Q-block, KV-block) partial attention in online-softmax form.
+
+    Returns ``(block_max [B,H,Tq], exp-weights sum [B,H,Tq],
+    weighted V [B,Tq,H,D])`` — un-normalised pieces for the accumulator.
+    """
+    # [B, H, Tq, Tk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = scores.max(axis=-1)  # [B, H, Tq]
+    # guard fully-masked rows (all -inf): exp(-inf - -inf) would be NaN
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    den = p.sum(axis=-1)  # [B, H, Tq]
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return safe_m, den, num
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact multi-head attention over a sequence sharded on *axis_name*.
+
+    ``q/k/v``: [B, T_local, H, D] local blocks (must run inside
+    ``shard_map``).  Returns [B, T_local, H, D].
+    """
+    P = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    q_pos = rank * T + jnp.arange(T)  # global positions of my queries
+
+    def step(carry, s):
+        k_blk, v_blk, m, den, num = carry
+        # the block currently held arrived from rank - s (ring order)
+        src = (rank - s) % P
+        kv_pos = src * T + jnp.arange(T)
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]   # [Tq, Tk]
+        else:
+            mask = jnp.ones((T, T), bool)
+        bm, bden, bnum = _block_attn(q, k_blk, v_blk,
+                                     mask[None, None], scale)
+        new_m = jnp.maximum(m, bm)
+        corr_old = jnp.exp(m - new_m)
+        corr_new = jnp.exp(bm - new_m)
+        den = den * corr_old + bden * corr_new
+        # broadcast the [B,H,T] corrections over the [B,T,H,D] accumulator
+        num = (num * jnp.moveaxis(corr_old, 1, 2)[..., None]
+               + bnum * jnp.moveaxis(corr_new, 1, 2)[..., None])
+        # rotate K/V to the next device; after P-1 rotations every device
+        # has seen every block
+        perm = [(i, (i + 1) % P) for i in range(P)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, new_m, den, num), None
+
+    # the scan carry must enter with the same device-varying type the body
+    # produces; deriving the zero accumulators from q inherits q's vma
+    # regardless of how many mesh axes enclose us (sp alone, or sp x tp)
+    stat0 = jnp.moveaxis(q[..., 0] * 0.0, 1, 2)  # [B, H, T] zeros
+    m0 = stat0 - jnp.inf
+    den0 = stat0
+    num0 = q * 0.0
+    (k_f, v_f, m, den, num), _ = jax.lax.scan(
+        step, (k, v, m0, den0, num0), jnp.arange(P))
+
+    den = jnp.moveaxis(den, 1, 2)[..., None]  # [B, T, H, 1]
+    return num / jnp.maximum(den, 1e-20)
+
+
+def full_attention_reference(q, k, v, causal: bool = True,
+                             scale: Optional[float] = None) -> jax.Array:
+    """Unsharded oracle: plain softmax attention over the GLOBAL sequence
+    ([B, T, H, D]); tests diff ring_attention against this."""
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)

@@ -1,0 +1,90 @@
+"""CLI-layer tests: module-name normalization, the wordcountbig glob
+taskfn, the drop command, and facade export parity with the reference
+(init.lua:25-38)."""
+
+import uuid
+
+import pytest
+
+from mapreduce_tpu import spec
+from mapreduce_tpu.cli import normalize_module
+
+
+@pytest.fixture(autouse=True)
+def fresh_modules():
+    spec.clear_caches()
+    yield
+    spec.clear_caches()
+
+
+def test_normalize_module():
+    assert normalize_module("pkg/mod.py") == "pkg.mod"
+    assert normalize_module("pkg.mod") == "pkg.mod"
+    assert normalize_module("a/b/c.py") == "a.b.c"
+
+
+def test_facade_exports():
+    """Reference facade: {worker, server, utils, tuple, persistent_table}
+    (init.lua:25-38)."""
+    import mapreduce_tpu as mr
+
+    assert hasattr(mr.server, "Server")
+    assert hasattr(mr.worker, "Worker")
+    assert callable(mr.interning.intern)          # tuple.lua role
+    assert mr.tuple_module is mr.interning
+    assert hasattr(mr.persistent_table, "PersistentTable")
+    assert hasattr(mr, "STATUS") and hasattr(mr, "TASK_STATUS")
+    assert mr.interning.stats()["size"] >= 0      # tuple.stats parity
+    with pytest.raises(AttributeError):
+        mr.no_such_attr
+
+
+def test_wordcountbig_glob(tmp_path):
+    from mapreduce_tpu.examples import naive
+    from mapreduce_tpu.server import Server
+    from mapreduce_tpu.worker import spawn_worker_threads
+
+    files = []
+    for i in range(3):
+        p = tmp_path / f"split-{i:03d}.txt"
+        p.write_text(f"big corpus split {i} words words\n" * 4)
+        files.append(str(p))
+    (tmp_path / "notmatched.dat").write_text("excluded tokens\n")
+
+    m = "mapreduce_tpu.examples.wordcountbig"
+    params = {r: m for r in ("taskfn", "mapfn", "partitionfn", "reducefn",
+                             "finalfn")}
+    params["storage"] = f"mem:{uuid.uuid4().hex}"
+    params["init_args"] = {"glob": str(tmp_path / "split-*.txt"),
+                           "num_reducers": 4}
+    connstr = f"mem://{uuid.uuid4().hex}"
+    threads = spawn_worker_threads(connstr, "big", 2)
+    server = Server(connstr, "big")
+    server.configure(params)
+    stats = server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    from mapreduce_tpu.examples.wordcountbig import RESULT
+    assert RESULT == naive.wordcount(files)
+    assert "excluded" not in RESULT
+    assert stats["map"]["count"] == 3
+
+
+def test_cli_drop(tmp_path):
+    from mapreduce_tpu.cli import cmd_drop
+    from mapreduce_tpu.coord import docstore
+    from mapreduce_tpu import storage as storage_mod
+
+    root = str(tmp_path / "store")
+    store = docstore.connect(f"dir://{root}")
+    store.insert("db1.task", {"x": 1})
+    store.insert("db1.map_jobs", {"x": 1})
+    store.insert("other.task", {"x": 1})
+    st = storage_mod.router(f"shared:{tmp_path}/blobs")
+    st.write("result.P00001", "data\n")
+    rc = cmd_drop([f"dir://{root}", "db1",
+                   "--storage", f"shared:{tmp_path}/blobs"])
+    assert rc == 0
+    assert store.count("db1.task") == 0
+    assert store.count("other.task") == 1  # untouched
+    assert st.list() == []

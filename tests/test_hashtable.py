@@ -1,0 +1,116 @@
+"""Unit tests for the scatter-based hash-table aggregation
+(ops/hashtable.py) — exactness under collisions, probe exhaustion
+leftovers, monoid ops, and the disjointness guarantee."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mapreduce_tpu.ops.hashtable import (
+    SENTINEL, aggregate_disjoint, empty_table, table_compact, table_insert)
+
+
+def _records(pairs):
+    """pairs: list of ((h1,h2), value, payload)"""
+    keys = jnp.asarray([p[0] for p in pairs], dtype=jnp.uint32)
+    vals = jnp.asarray([p[1] for p in pairs], dtype=jnp.int32)
+    pay = jnp.asarray([[p[2]] for p in pairs], dtype=jnp.int32)
+    valid = jnp.ones((len(pairs),), bool)
+    return keys, vals, pay, valid
+
+
+def _as_dict(combined):
+    out = {}
+    for i in range(combined.keys.shape[0]):
+        if bool(combined.valid[i]):
+            out[(int(combined.keys[i, 0]), int(combined.keys[i, 1]))] = \
+                int(combined.values[i])
+    return out
+
+
+def test_insert_and_compact_exact_sums():
+    keys, vals, pay, valid = _records([
+        ((1, 1), 10, 0), ((2, 2), 5, 1), ((1, 1), 7, 2), ((3, 3), 1, 3)])
+    table = empty_table(16, (), jnp.int32, (1,), jnp.int32)
+    table, leftover = table_insert(table, keys, vals, pay, valid)
+    assert not bool(leftover.any())
+    out = table_compact(table, 8)
+    assert int(out.n_unique) == 3
+    assert _as_dict(out) == {(1, 1): 17, (2, 2): 5, (3, 3): 1}
+
+
+def test_slot_collisions_never_merge_distinct_keys():
+    """Keys engineered to collide on every probe of a 4-slot table must
+    still aggregate exactly (via leftovers), never merge."""
+    # h1 % 4 equal and identical odd stride => same probe sequence
+    a, b, c = (4, 1), (8, 1), (12, 1)
+    keys, vals, pay, valid = _records([
+        (a, 1, 0), (b, 10, 1), (c, 100, 2), (a, 1, 3), (b, 10, 4)])
+    table = empty_table(4, (), jnp.int32, (1,), jnp.int32)
+    table, leftover = table_insert(table, keys, vals, pay, valid,
+                                   n_rounds=2)
+    got = _as_dict(table_compact(table, 4))
+    n_left = int(leftover.sum())
+    # every record either folded exactly or is left over; totals preserved
+    total_in_table = sum(got.values())
+    assert total_in_table + int(vals[leftover].sum()) == 122
+    # leftover keys are disjoint from table keys
+    left_keys = {(int(keys[i, 0]), int(keys[i, 1]))
+                 for i in range(5) if bool(leftover[i])}
+    assert not (left_keys & set(got.keys()))
+
+
+def test_aggregate_disjoint_union_is_exact():
+    rng = np.random.default_rng(0)
+    n = 4096
+    raw = rng.integers(0, 50, size=n)  # 50 distinct keys, many repeats
+    keys = jnp.stack([jnp.asarray(raw + 1, jnp.uint32),
+                      jnp.asarray(raw * 7 + 3, jnp.uint32)], axis=1)
+    vals = jnp.ones((n,), jnp.int32)
+    pay = jnp.asarray(np.arange(n)[:, None], jnp.int32)
+    valid = jnp.asarray(rng.random(n) < 0.9)
+    main, rest, oflow = aggregate_disjoint(
+        keys, vals, pay, valid, n_buckets=16, capacity=64,
+        leftover_capacity=64, n_rounds=2)
+    assert int(oflow) == 0
+    got = _as_dict(main)
+    rest_d = _as_dict(rest)
+    assert not (set(got) & set(rest_d))  # disjoint
+    got.update(rest_d)
+    expected = {}
+    for i in range(n):
+        if bool(valid[i]):
+            k = (int(keys[i, 0]), int(keys[i, 1]))
+            expected[k] = expected.get(k, 0) + 1
+    assert got == expected
+
+
+def test_min_max_ops():
+    keys, vals, pay, valid = _records([
+        ((5, 5), 9, 0), ((5, 5), 3, 1), ((6, 6), -2, 2), ((6, 6), 4, 3)])
+    for op, expect in (("min", {(5, 5): 3, (6, 6): -2}),
+                       ("max", {(5, 5): 9, (6, 6): 4})):
+        table = empty_table(16, (), jnp.int32, (1,), jnp.int32, op)
+        table, left = table_insert(table, keys, vals, pay, valid, op=op)
+        assert not bool(left.any())
+        assert _as_dict(table_compact(table, 8)) == expect
+
+
+def test_sentinel_key_is_remapped_not_lost():
+    s = int(SENTINEL)
+    keys, vals, pay, valid = _records([((s, s), 5, 0), ((s, s), 2, 1)])
+    table = empty_table(8, (), jnp.int32, (1,), jnp.int32)
+    table, left = table_insert(table, keys, vals, pay, valid)
+    assert not bool(left.any())
+    out = table_compact(table, 4)
+    assert _as_dict(out) == {(0, 0): 7}
+
+
+def test_empty_input():
+    table = empty_table(8, (), jnp.int32, (1,), jnp.int32)
+    keys = jnp.zeros((4, 2), jnp.uint32)
+    table, left = table_insert(table, keys, jnp.zeros((4,), jnp.int32),
+                               jnp.zeros((4, 1), jnp.int32),
+                               jnp.zeros((4,), bool))
+    out = table_compact(table, 4)
+    assert int(out.n_unique) == 0

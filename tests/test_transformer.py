@@ -1,0 +1,103 @@
+"""Ring attention + transformer tests on the virtual 8-device mesh: the
+sharded computation must match unsharded oracles to float tolerance, and
+the sp x tp training step must actually learn."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as PS
+
+from mapreduce_tpu.models.transformer import (
+    TransformerConfig, TransformerTrainer, init_transformer)
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.parallel.ring import (
+    full_attention_reference, ring_attention)
+
+
+def _qkv(B=2, T=32, H=4, D=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.normal(size=(B, T, H, D)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(causal):
+    mesh = make_mesh()  # data=8
+    q, k, v = _qkv()
+    fn = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "data", causal=causal),
+        mesh=mesh,
+        in_specs=(PS(None, "data"),) * 3, out_specs=PS(None, "data")))
+    got = np.asarray(fn(q, k, v))
+    want = np.asarray(full_attention_reference(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_single_device_degenerates():
+    mesh = make_mesh(n_data=1, n_model=1)
+    q, k, v = _qkv(T=16)
+    fn = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "data"),
+        mesh=mesh, in_specs=(PS(None, "data"),) * 3,
+        out_specs=PS(None, "data"))
+    got = np.asarray(fn(q, k, v))
+    want = np.asarray(full_attention_reference(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def _batch(rng, cfg, B, T):
+    """Learnable synthetic language: tok[t+1] = (tok[t] + 1) % K with
+    occasional resets — a next-token task a tiny LM must crack."""
+    K = cfg.vocab
+    toks = np.zeros((B, T + 1), dtype=np.int32)
+    toks[:, 0] = rng.integers(0, K, size=B)
+    for t in range(T):
+        toks[:, t + 1] = (toks[:, t] + 1) % K
+    return toks
+
+
+def test_transformer_sp_tp_trains():
+    mesh = make_mesh(n_model=2)  # model=2 x data=4: tp x sp
+    cfg = TransformerConfig(vocab=32, embed=64, n_layers=2, n_heads=4,
+                            head_dim=16, ffn=128)
+    trainer = TransformerTrainer(mesh, cfg, learning_rate=3e-2)
+    params = trainer.init_params()
+    rng = np.random.default_rng(0)
+    losses = []
+    for it in range(80):
+        toks = _batch(rng, cfg, B=8, T=32)
+        params, loss = trainer.step(params, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+    assert losses[-1] < 1.2, losses[-20:]
+
+
+def test_transformer_loss_matches_unsharded():
+    """The sharded vocab/sequence cross-entropy must equal a plain
+    unsharded computation of the same model."""
+    mesh = make_mesh(n_model=2)
+    cfg = TransformerConfig(vocab=32, embed=32, n_layers=1, n_heads=4,
+                            head_dim=8, ffn=64, dtype=jnp.float32)
+    trainer = TransformerTrainer(mesh, cfg)
+    params_host = init_transformer(jax.random.key(trainer.seed), cfg)
+    params = trainer.init_params()
+    rng = np.random.default_rng(1)
+    toks = _batch(rng, cfg, B=2, T=16)
+    x, y = trainer.place_batch(toks)
+    got = float(trainer._loss(params, x, y))
+
+    # unsharded oracle: same math with n_model=1 axes absent
+    from mapreduce_tpu.models.transformer import loss_local
+    one = make_mesh(n_data=1, n_model=1)
+    oracle = jax.shard_map(
+        lambda p, a, b: loss_local(p, a, b, cfg, 1),
+        mesh=one,
+        in_specs=({n: PS() for n in params_host}, PS(None, "data"),
+                  PS(None, "data")),
+        out_specs=PS())
+    want = float(oracle(params_host, toks[:, :-1], toks[:, 1:]))
+    assert abs(got - want) < 1e-3, (got, want)
