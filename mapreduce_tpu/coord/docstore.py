@@ -280,6 +280,7 @@ class DirDocStore(DocStore):
         self._local_locks: Dict[str, threading.Lock] = {}
         self._llock = threading.Lock()
         self._fds: Dict[str, int] = {}
+        self._closed = False
 
     def _coll_dir(self, coll: str) -> str:
         safe = coll.replace("/", "_")
@@ -408,13 +409,26 @@ class DirDocStore(DocStore):
         return out
 
     def close(self) -> None:
+        # refuse new fd opens from this point on, then close every open fd
+        # under ITS collection's thread lock — a _DirLock mid-critical-
+        # section keeps its flock until __exit__, and a blocked one finds
+        # the store closed instead of a stale/reused descriptor
         with self._llock:
-            for fd in self._fds.values():
-                try:
-                    os.close(fd)
-                except OSError:
-                    pass
-            self._fds.clear()
+            self._closed = True
+        while True:
+            with self._llock:
+                coll = next(iter(self._fds), None)
+                if coll is None:
+                    return
+                tlock = self._local_locks.setdefault(coll, threading.Lock())
+            with tlock:
+                with self._llock:
+                    fd = self._fds.pop(coll, None)
+                if fd is not None:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
 
 
 class _DirLock:
@@ -430,7 +444,15 @@ class _DirLock:
             fd = self.store._fds.get(self.coll)
             if fd is None:
                 fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
-                self.store._fds[self.coll] = fd
+                # the closed-check and the registration must be one
+                # critical section: otherwise a close() racing between
+                # them would scan _fds before this entry lands, return,
+                # and leave the "closed" store operable with a leaked fd
+                with self.store._llock:
+                    if self.store._closed:
+                        os.close(fd)
+                        raise RuntimeError("DirDocStore is closed")
+                    self.store._fds[self.coll] = fd
             fcntl.flock(fd, fcntl.LOCK_EX)
         except BaseException:
             # never leave the thread lock held on a failed acquire —

@@ -211,27 +211,36 @@ class Task:
         return None, st
 
     def heartbeat(self, job_tbl: JobDoc) -> None:
-        """Extend a RUNNING job's lease (no reference equivalent — fixes
+        """Extend an in-flight job's lease (no reference equivalent — fixes
         the missing dead-worker detection, SURVEY.md §5).  Guarded by the
         claim identity so a stale worker can't extend a lease that now
-        belongs to another worker's claim."""
+        belongs to another worker's claim.  Matches both RUNNING and
+        FINISHED: a map job is FINISHED while its worker is still writing
+        output files (job.py), and that write phase must keep the lease
+        alive too."""
         self._cnn.connect().update(
             self.jobs_ns(),
             {"_id": job_tbl["_id"],
              "worker": job_tbl.get("worker"),
              "tmpname": job_tbl.get("tmpname"),
-             "status": int(STATUS.RUNNING)},
+             "status": {"$in": [int(STATUS.RUNNING),
+                                int(STATUS.FINISHED)]}},
             {"$set": {"lease_expires": docstore.now() + self.job_lease}})
 
     def reap_expired(self, coll: str) -> int:
-        """Server-side: RUNNING jobs with an expired lease become BROKEN
-        (+1 repetition), making them claimable again."""
+        """Server-side: in-flight jobs (RUNNING, or FINISHED — user fn done
+        but output files not yet written) with an expired lease become
+        BROKEN (+1 repetition), making them claimable again.  FINISHED is
+        non-terminal: a worker dying between mark_as_finished and
+        mark_as_written would otherwise leave an unreapable job and hang
+        the server's poll loop forever."""
         store = self._cnn.connect()
         n = 0
         while True:
             got = store.find_and_modify(
                 coll,
-                {"status": int(STATUS.RUNNING),
+                {"status": {"$in": [int(STATUS.RUNNING),
+                                    int(STATUS.FINISHED)]},
                  "lease_expires": {"$lt": docstore.now()}},
                 {"$set": {"status": int(STATUS.BROKEN)},
                  "$inc": {"repetitions": 1}})

@@ -81,7 +81,11 @@ class DeviceWordCount:
 
     def count_bytes(self, data: bytes) -> Dict[bytes, int]:
         """Count whitespace-separated words of *data* (the user surface:
-        same answer as examples/naive.wordcount on the same bytes)."""
+        same answer as examples/naive.wordcount on the same bytes).
+
+        Counts are int32 end-to-end: a single key is exact up to 2**31-1
+        occurrences (~8 GB of one repeated 3-byte word) — beyond that the
+        count wraps.  Corpora near that bound need a wider value lane."""
         n_chunks = max(1, -(-len(data) // self.chunk_len))
         # round chunks up to a mesh multiple so every device participates
         n_dev = self.mesh.shape["data"]

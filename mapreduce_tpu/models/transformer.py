@@ -59,7 +59,7 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> Params:
         return jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)
 
     keys = jax.random.split(key, 2 + 6 * cfg.n_layers)
-    params["embed"] = norm(keys[0], (V, E), 1.0) * 0.02 / 0.02
+    params["embed"] = norm(keys[0], (V, E), 1.0) * 0.02
     params["unembed"] = norm(keys[1], (E, V), E)
     for i in range(cfg.n_layers):
         k0 = 2 + 6 * i

@@ -168,3 +168,39 @@ def test_server_crash_resume_at_map(corpus):
     docs = s2.cnn.connect().find(s2.task.map_jobs_ns())
     assert len(docs) == 4
     assert all(d["status"] == int(STATUS.WRITTEN) for d in docs)
+
+
+def test_worker_death_between_finished_and_written_is_reaped(corpus):
+    """A worker dying AFTER mark_as_finished but BEFORE mark_as_written
+    leaves the job in FINISHED — non-terminal.  The lease reaper must treat
+    FINISHED like RUNNING (advisor finding r1) or the server's poll loop
+    would hang forever waiting on an unreapable job."""
+    from mapreduce_tpu.coord.connection import Connection
+    from mapreduce_tpu.coord.task import Task
+
+    faulty_mods.reset(corpus)
+    connstr = f"mem://{uuid.uuid4().hex}"
+    server = Server(connstr, "ft6", job_lease=0.3)
+    server.configure(_params(corpus))
+    server.task.create_collection(TASK_STATUS.WAIT, server.params, 1)
+    server._prepare_map()
+    # zombie claims a job and "dies" right after the FINISHED transition
+    zombie_task = Task(Connection(connstr, "ft6"), job_lease=0.3)
+    job, _ = zombie_task.take_next_job("zombie", "t")
+    assert job is not None
+    server.cnn.connect().update(
+        server.task.map_jobs_ns(), {"_id": job["_id"]},
+        {"$set": {"status": int(STATUS.FINISHED)}})
+    threads = spawn_worker_threads(connstr, "ft6", 2)
+    server._poll_phase(server.task.map_jobs_ns(), "map")
+    server._prepare_reduce()
+    server._poll_phase(server.task.red_jobs_ns(), "reduce")
+    server._compute_stats()
+    server._final()
+    for t in threads:
+        t.join(timeout=30)
+    assert faulty_mods.RESULT == naive.wordcount(corpus)
+    docs = server.cnn.connect().find(server.task.map_jobs_ns(),
+                                     {"_id": job["_id"]})
+    assert docs[0]["repetitions"] >= 1
+    assert docs[0]["status"] == int(STATUS.WRITTEN)
