@@ -2,12 +2,14 @@
 
 The reference's flagship workload — Europarl word-count, 197 splits, its
 whole performance story (README.md:40-113, BASELINE.md) — runs here as one
-SPMD program: on-device tokenization + hashing (ops/tokenize.py), local
-segmented combine, hash-partition + all_to_all, segmented count reduce,
-then host-side materialisation of the unique words by slicing the original
-bytes at one representative occurrence per hash.  The host never loops
-over tokens; it only loops over *unique words* (the vocabulary, thousands
-of times smaller than the corpus).
+SPMD program: on-device tokenization + hashing (ops/tokenize.py),
+scatter-free tile compaction of word records (ops/compaction.py), ONE
+device-wide sort + segmented count (ops/segscan.py via the engine),
+hash-partition + all_to_all, then host-side materialisation of the unique
+words by slicing the original bytes at one representative occurrence per
+hash.  The host never loops over tokens; it only loops over *unique
+words* (the vocabulary, thousands of times smaller than the corpus), and
+that loop is numpy window-gather, not per-element Python.
 """
 
 from __future__ import annotations
@@ -18,30 +20,38 @@ import numpy as np
 
 from jax.sharding import Mesh
 
-from ..ops.segmented import compact
+from ..ops.compaction import tile_compact
 from ..ops.tokenize import tokenize_hash, shard_text
 from .device_engine import DeviceEngine, EngineConfig
 
+#: whitespace byte values (must match ops/tokenize._WS)
+_WS_BYTES = (32, 9, 10, 13, 12, 11)
+#: host materialisation window: words longer than this fall back to a
+#: per-row Python scan (vanishingly rare in natural language)
+_WINDOW = 128
 
-def _wordcount_map_fn(token_capacity: int):
+
+def _wordcount_map_fn(chunk, chunk_index, cfg: EngineConfig):
     """map_fn: one padded byte chunk -> (hash-keys, count=1, payload) with
-    payload = (global_chunk_index, start_offset, length) so the host can
-    slice the word's bytes back out."""
+    payload = the word's global start byte offset (chunk_index * L +
+    local start), from which the host slices the word's bytes back out.
+
+    Tile compaction (one-hot matmul, no scatter) packs the per-byte
+    token stream into at most ``L // cfg.tile * cfg.tile_records``
+    records; drops are counted and the engine retries with doubled
+    tile_records."""
     import jax.numpy as jnp
 
-    def map_fn(chunk, chunk_index):
-        toks = tokenize_hash(chunk)
-        # (broadcasted add, not full_like: the fill value is an
-        # axis-varying tracer under shard_map)
-        idx_lane = jnp.zeros_like(toks.start) + chunk_index
-        pos_payload = jnp.stack([idx_lane, toks.start, toks.length], axis=-1)
-        (keys, payload), valid, n = compact(
-            toks.is_end, token_capacity, toks.keys, pos_payload)
-        values = valid.astype(jnp.int32)
-        overflow = jnp.maximum(n - token_capacity, 0)
-        return keys, values, payload, valid, overflow
-
-    return map_fn
+    L = chunk.shape[0]
+    toks = tokenize_hash(chunk)
+    gstart = chunk_index * L + toks.start  # global byte offset, fits i32
+    tc = tile_compact(toks.is_end, cfg.tile, cfg.tile_records,
+                      toks.keys[:, 0], toks.keys[:, 1], gstart)
+    k1, k2, gs = tc.arrays
+    keys = jnp.stack([k1, k2], axis=-1)
+    values = tc.valid.astype(jnp.int32)
+    payload = gs.astype(jnp.int32)[:, None]
+    return keys, values, payload, tc.valid, tc.overflow
 
 
 class DeviceWordCount:
@@ -52,25 +62,26 @@ class DeviceWordCount:
     automatically on overflow (DeviceEngine.run).
     """
 
-    def __init__(self, mesh: Mesh, chunk_len: int = 1 << 20,
+    def __init__(self, mesh: Mesh, chunk_len: int = 1 << 22,
                  config: Optional[EngineConfig] = None) -> None:
         self.mesh = mesh
         self.chunk_len = chunk_len
-        self.config = config or EngineConfig(
+        cfg = config or EngineConfig(
             local_capacity=1 << 17, exchange_capacity=1 << 15,
-            out_capacity=1 << 17, table_buckets=1 << 19,
-            residual_capacity=1 << 13)
+            out_capacity=1 << 17)
+        # wordcount records are unit counts: run lengths replace a value
+        # lane (drops one sort operand)
+        from dataclasses import replace
+        cfg = replace(cfg, unit_values=True, reduce_op="sum",
+                      tile=min(cfg.tile, chunk_len))
+        self.config = cfg
         self._engines: Dict[int, DeviceEngine] = {}
 
     def _engine_for(self, padded_len: int) -> DeviceEngine:
-        """One engine per padded chunk length.  token_capacity is L//2+1 —
-        a whitespace-separated chunk of L bytes holds at most (L+1)//2
-        words, so token compaction can never overflow (the remaining
-        capacities still grow on overflow via DeviceEngine.run)."""
+        """One engine per padded chunk length."""
         if padded_len not in self._engines:
             self._engines[padded_len] = DeviceEngine(
-                self.mesh, _wordcount_map_fn(padded_len // 2 + 1),
-                self.config)
+                self.mesh, _wordcount_map_fn, self.config)
         return self._engines[padded_len]
 
     @property
@@ -90,23 +101,13 @@ class DeviceWordCount:
         # round chunks up to a mesh multiple so every device participates
         n_dev = self.mesh.shape["data"]
         n_chunks = -(-n_chunks // n_dev) * n_dev
-        chunks, L = shard_text(data, n_chunks, pad_multiple=128)
+        chunks, L = shard_text(data, n_chunks, pad_multiple=self.config.tile)
         result = self._engine_for(L).run(chunks)
         if result.overflow:
             raise RuntimeError(
                 f"wordcount overflowed capacities by {result.overflow} "
                 "rows even after retries; raise EngineConfig capacities")
-        counts: Dict[bytes, int] = {}
-        P_, C = result.valid.shape
-        for p in range(P_):
-            live = np.nonzero(result.valid[p])[0]
-            pay = result.payload[p]
-            vals = result.values[p]
-            for i in live:
-                ci, start, length = pay[i]
-                word = bytes(chunks[ci, start:start + length])
-                counts[word] = counts.get(word, 0) + int(vals[i])
-        return counts
+        return materialize_counts(chunks, result)
 
     def count_files(self, paths) -> Dict[bytes, int]:
         parts = []
@@ -114,3 +115,51 @@ class DeviceWordCount:
             with open(p, "rb") as f:
                 parts.append(f.read())
         return self.count_bytes(b"\n".join(parts))
+
+
+def materialize_counts(chunks: np.ndarray, result) -> Dict[bytes, int]:
+    """Host materialisation, vectorised: gather a fixed window of bytes at
+    every unique word's start offset with one numpy fancy-index, find each
+    word's end as the first whitespace in its window, then build the dict
+    over uniques only.  (Round 1 looped Python over every unique with
+    per-element array slicing — on the timed path of the flagship bench.)
+    """
+    S, L = chunks.shape
+    valid = result.valid.reshape(-1)
+    starts = result.payload.reshape(-1, result.payload.shape[-1])[:, 0]
+    vals = result.values.reshape(-1)
+    live_rows = np.nonzero(valid)[0]
+    if live_rows.size == 0:
+        return {}
+    gstart = starts[live_rows].astype(np.int64)
+    counts = vals[live_rows]
+
+    flat = chunks.reshape(-1)
+    # windows[i] = corpus bytes [gstart_i, gstart_i + _WINDOW)
+    offs = gstart[:, None] + np.arange(_WINDOW)[None, :]
+    np.clip(offs, 0, flat.size - 1, out=offs)
+    windows = flat[offs]  # [U, W] uint8
+    is_ws = np.isin(windows, _WS_BYTES)
+    # words never span chunks (shard_text cuts at whitespace) and chunks
+    # are space-padded, so a separator always exists inside the window
+    # for words shorter than it
+    has_end = is_ws.any(axis=1)
+    lengths = np.where(has_end, is_ws.argmax(axis=1), _WINDOW)
+
+    out: Dict[bytes, int] = {}
+    win_bytes = windows.tobytes()
+    W = _WINDOW
+    for i in range(live_rows.size):
+        ln = lengths[i]
+        if has_end[i]:
+            word = win_bytes[i * W:i * W + ln]
+        else:  # overlong word: rare fallback, scan the original bytes
+            g = int(gstart[i])
+            row, col = divmod(g, L)
+            end = col
+            crow = chunks[row]
+            while end < L and crow[end] not in _WS_BYTES:
+                end += 1
+            word = crow[col:end].tobytes()
+        out[word] = out.get(word, 0) + int(counts[i])
+    return out

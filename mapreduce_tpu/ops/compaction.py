@@ -1,0 +1,82 @@
+"""Scatter-free record compaction via exact one-hot matmuls (MXU path).
+
+Dense record extraction from per-position masks is the first step of the
+device map phase (the role job.lua:77-97's per-token ``table.insert``
+plays on the host).  The obvious XLA formulation — cumsum + scatter rows
+to their rank (segmented.compact) — is wrong for TPU at scale: scatter
+throughput measured on v5e is ~100M elements/s, so compacting each 4MB
+chunk's per-byte arrays costs ~150ms, dwarfing every other stage.
+
+The TPU-native answer keeps the FLOPs on the systolic array: split
+positions into tiles of width W, rank valid positions within their tile
+(a tiny cumsum), build a one-hot [W, K] placement matrix per tile, and
+compact with a batched matmul ``out[t] = onehot[t]^T @ data[t]``.  Each
+output slot receives exactly one 0/1-weighted row, so the result is EXACT
+provided every matmul operand fits the mantissa: operands are decomposed
+into BYTE lanes (values <= 255, exact in bf16) and reassembled in int32.
+
+Rows never leave their tile (output is [n_tiles, K] with per-tile
+validity) — global packing is deliberately skipped because the engine
+sorts all records immediately afterwards, and a sort does not care about
+padding order.  Records past K per tile are dropped but COUNTED
+(``overflow``), and the engine retries with doubled K (SURVEY.md §7(a)).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TileCompacted(NamedTuple):
+    arrays: Tuple[jax.Array, ...]  # each [n_tiles * K] int32/uint32
+    valid: jax.Array               # [n_tiles * K] bool
+    overflow: jax.Array            # [] int32 — rows dropped for K
+
+
+def tile_compact(mask: jax.Array, tile: int, capacity: int,
+                 *arrays: jax.Array) -> TileCompacted:
+    """Compact the rows of 1-D *arrays* where *mask* is set, tile-locally.
+
+    ``mask``: [L] bool, ``arrays``: [L] int32/uint32, ``L % tile == 0``.
+    Output arrays are [L // tile * capacity] with a matching valid mask;
+    rows of tile t occupy slots [t*capacity, t*capacity + count_t).
+    """
+    L = mask.shape[0]
+    if L % tile != 0:
+        raise ValueError(f"L={L} not a multiple of tile={tile}")
+    T = L // tile
+    K = capacity
+    m2 = mask.reshape(T, tile)
+    rank = jnp.cumsum(m2.astype(jnp.int32), axis=1) - 1
+    counts = rank[:, -1] + 1
+    overflow = jnp.maximum(counts - K, 0).sum().astype(jnp.int32)
+    # out-of-range slot (>= K, or masked-off) -> all-zero one-hot row
+    slot = jnp.where(m2, rank, K)
+    onehot = jax.nn.one_hot(slot, K, dtype=jnp.bfloat16, axis=-1)
+
+    # byte-decompose each operand: bf16 holds integers <= 256 exactly, and
+    # every output cell is a single 0/1-weighted byte, so the f32
+    # accumulation is exact
+    lanes = []
+    for a in arrays:
+        x = a.astype(jnp.uint32).reshape(T, tile)
+        for b in range(4):
+            lanes.append(((x >> jnp.uint32(8 * b)) & jnp.uint32(255))
+                         .astype(jnp.bfloat16))
+    data = jnp.stack(lanes, axis=-1)  # [T, tile, 4*len(arrays)]
+    packed = jnp.einsum("twk,twl->tkl", onehot, data,
+                        preferred_element_type=jnp.float32)
+    packed = packed.astype(jnp.uint32)  # [T, K, 4*len(arrays)]
+
+    outs = []
+    for i, a in enumerate(arrays):
+        b0, b1, b2, b3 = (packed[..., 4 * i + j] for j in range(4))
+        word = (b0 | (b1 << jnp.uint32(8)) | (b2 << jnp.uint32(16))
+                | (b3 << jnp.uint32(24)))
+        outs.append(word.astype(a.dtype).reshape(T * K))
+    valid = (jnp.arange(K)[None, :] < jnp.minimum(counts, K)[:, None]
+             ).reshape(T * K)
+    return TileCompacted(tuple(outs), valid, overflow)

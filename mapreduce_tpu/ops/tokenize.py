@@ -73,12 +73,48 @@ def _affine_combine(left, right):
     return ml * mr, cl * mr + cr
 
 
-#: inner tile width for the two-level scans.  A flat associative_scan over
-#: millions of elements unrolls into log2(L) levels of odd-shaped slices
-#: that TPU XLA compiles pathologically slowly (>10min at L=4M, measured);
-#: scanning [L/W, W] tiles along the short axis + a small cross-tile
-#: prefix pass keeps every intermediate a clean 2-D array.
+#: inner tile width for the two-level scans.  A flat scan over millions of
+#: elements costs log2(L) full-array passes; scanning [L/W, W] tiles along
+#: the short axis + a small cross-tile prefix pass cuts the full-width
+#: passes to log2(W) and keeps every intermediate a clean 2-D array.
 SCAN_TILE = 512
+
+
+def _shifted(x: jax.Array, d: int, fill) -> jax.Array:
+    """x shifted right by d along its LAST axis, filling with *fill*."""
+    pad = jnp.full(x.shape[:-1] + (d,), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-d]], axis=-1)
+
+
+def _hillis_affine(m: jax.Array, c: jax.Array):
+    """Inclusive scan of affine maps h->m*h+c along the last axis, as an
+    UNROLLED Hillis-Steele ladder of log2(L) static shift+multiply-add
+    passes.  jax.lax.associative_scan's recursive odd/even slicing
+    compiles pathologically on TPU at multi-million-element widths
+    (>10 min at L=4M, measured — the round-1 bench killer); this emits
+    only pad/slice/mul/add HLO with static shapes, which XLA compiles in
+    seconds and runs at HBM bandwidth."""
+    L = m.shape[-1]
+    d = 1
+    while d < L:
+        ml = _shifted(m, d, 1)
+        cl = _shifted(c, d, 0)
+        # compose right∘left BEFORE overwriting m: (m*ml, m*cl + c)
+        m, c = m * ml, m * cl + c
+        d *= 2
+    return m, c
+
+
+def _hillis_max(x: jax.Array) -> jax.Array:
+    """Inclusive running max along the last axis (same ladder)."""
+    L = x.shape[-1]
+    lowest = (jnp.iinfo(x.dtype).min
+              if jnp.issubdtype(x.dtype, jnp.integer) else -jnp.inf)
+    d = 1
+    while d < L:
+        x = jnp.maximum(x, _shifted(x, d, lowest))
+        d *= 2
+    return x
 
 
 def _affine_scan(m: jax.Array, c: jax.Array) -> jax.Array:
@@ -92,14 +128,14 @@ def _affine_scan(m: jax.Array, c: jax.Array) -> jax.Array:
     L = m.shape[0]
     W = SCAN_TILE
     if L % W != 0 or L <= W:
-        _, c_out = jax.lax.associative_scan(_affine_combine, (m, c))
+        _, c_out = _hillis_affine(m, c)
         return c_out
     mb = m.reshape(L // W, W)
     cb = c.reshape(L // W, W)
-    Mi, Ci = jax.lax.associative_scan(_affine_combine, (mb, cb), axis=1)
+    Mi, Ci = _hillis_affine(mb, cb)
     # exclusive prefix of per-tile totals (last column), shifted by one
     Mt, Ct = Mi[:, -1], Ci[:, -1]
-    Mp, Cp = jax.lax.associative_scan(_affine_combine, (Mt, Ct))
+    Mp, Cp = _hillis_affine(Mt, Ct)
     one = jnp.ones((1,), m.dtype)
     zero = jnp.zeros((1,), c.dtype)
     Mp = jnp.concatenate([one, Mp[:-1]])
@@ -113,11 +149,11 @@ def _cummax_scan(x: jax.Array) -> jax.Array:
     L = x.shape[0]
     W = SCAN_TILE
     if L % W != 0 or L <= W:
-        return jax.lax.associative_scan(jnp.maximum, x)
+        return _hillis_max(x)
     xb = x.reshape(L // W, W)
-    inner = jax.lax.associative_scan(jnp.maximum, xb, axis=1)
+    inner = _hillis_max(xb)
     totals = inner[:, -1]
-    prefix = jax.lax.associative_scan(jnp.maximum, totals)
+    prefix = _hillis_max(totals)
     lowest = jnp.full((1,), jnp.iinfo(x.dtype).min
                       if jnp.issubdtype(x.dtype, jnp.integer) else -jnp.inf,
                       x.dtype)

@@ -1,0 +1,99 @@
+"""Profile candidate primitives for the engine hot path on the real chip.
+
+Measures, per primitive: compile time and steady-state wall per call.
+Run: python scratch/prof_primitives.py [sizes...]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def bench(name, fn, *args, reps=5):
+    t0 = time.time()
+    out = jax.block_until_ready(jax.jit(fn)(*args))
+    compile_s = time.time() - t0
+    jfn = jax.jit(fn)
+    t0 = time.time()
+    for _ in range(reps):
+        out = jax.block_until_ready(jfn(*args))
+    per = (time.time() - t0) / reps
+    print(f"{name:44s} compile {compile_s:7.2f}s   run {per*1e3:9.2f} ms")
+    return per
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    print("device:", dev, dev.platform)
+
+    N = 1 << 21  # 2M records
+    B = 1 << 21  # 2M buckets
+    keys = jnp.asarray(rng.integers(0, 1 << 31, size=N, dtype=np.int32))
+    keys2 = jnp.asarray(rng.integers(0, 1 << 31, size=N, dtype=np.int32))
+    vals = jnp.ones((N,), jnp.int32)
+    slots = jnp.asarray(rng.integers(0, B, size=N, dtype=np.int32))
+
+    # 1. scatter-add N into B
+    bench("scatter-add 2M->2M", lambda s, v: jnp.zeros((B,), jnp.int32).at[s].add(v), slots, vals)
+    # 1b. smaller scatter
+    Ns = 1 << 16
+    bench("scatter-add 64K->2M", lambda s, v: jnp.zeros((B,), jnp.int32).at[s].add(v), slots[:Ns], vals[:Ns])
+    # 2. gather N from B
+    table = jnp.asarray(rng.integers(0, 100, size=B, dtype=np.int32))
+    bench("gather 2M from 2M", lambda t, s: t[s], table, slots)
+    # 3. sort single key
+    bench("sort 2M x int32 (1 operand)", lambda k: jax.lax.sort(k), keys)
+    # 4. variadic sort key + 4 payload lanes
+    def vsort(k1, k2, v):
+        return jax.lax.sort((k1, k2, v, v, v), num_keys=2)
+    bench("sort 2M x (2 keys + 3 lanes)", vsort, keys, keys2, vals)
+    # 5. sort 16M single
+    keys16 = jnp.asarray(rng.integers(0, 1 << 31, size=1 << 24, dtype=np.int32))
+    bench("sort 16M x int32", lambda k: jax.lax.sort(k), keys16)
+    # 6. one-hot matmul histogram: ids -> [1024,1024] via segment decompose
+    ids = jnp.asarray(rng.integers(0, 1 << 20, size=N, dtype=np.int32))
+
+    def matmul_hist(ids):
+        hi = ids >> 10
+        lo = ids & 1023
+        # tile over N to bound memory: [T, 1024] onehots
+        T = 1 << 13
+        def body(c, idx):
+            h = jax.lax.dynamic_slice(hi, (idx * T,), (T,))
+            l = jax.lax.dynamic_slice(lo, (idx * T,), (T,))
+            oh = jax.nn.one_hot(h, 1024, dtype=jnp.bfloat16)
+            ol = jax.nn.one_hot(l, 1024, dtype=jnp.bfloat16)
+            return c + jnp.dot(oh.T, ol, preferred_element_type=jnp.float32), None
+        c0 = jnp.zeros((1024, 1024), jnp.float32)
+        out, _ = jax.lax.scan(body, c0, jnp.arange(N // T))
+        return out
+    bench("matmul-hist 2M ids -> 2^20 bins (bf16)", matmul_hist, ids)
+
+    # 7. the tokenizer scans at 4M
+    sys.path.insert(0, "/root/repo")
+    from mapreduce_tpu.ops.tokenize import tokenize_hash
+    chunk = jnp.asarray(rng.integers(97, 110, size=1 << 22, dtype=np.uint8))
+    bench("tokenize_hash 4MB chunk", lambda c: tokenize_hash(c).keys, chunk)
+
+    # 8. cumsum 4M (for compaction cost reference)
+    x = jnp.asarray(rng.integers(0, 2, size=1 << 22, dtype=np.int32))
+    bench("cumsum 4M int32", lambda a: jnp.cumsum(a), x)
+
+    # 9. compaction via scatter: 4M -> 256K slots
+    flag = x.astype(bool)
+    def compact_scatter(fl, data):
+        idx = jnp.cumsum(fl.astype(jnp.int32)) - 1
+        idx = jnp.where(fl, idx, 1 << 18)
+        return jnp.zeros((1 << 18,), jnp.int32).at[idx].set(data, mode="drop")
+    bench("compact 4M->256K via scatter-set", compact_scatter, flag, x)
+
+    # 10. top_k for compaction: 4M -> 64K
+    scores = jnp.asarray(rng.integers(0, 1 << 30, size=1 << 22, dtype=np.int32))
+    bench("top_k 4M -> 64K", lambda s: jax.lax.top_k(s, 1 << 16)[0], scores)
+
+
+if __name__ == "__main__":
+    main()
