@@ -78,10 +78,7 @@ def main() -> None:
         mesh, chunk_len=1 << 22,
         config=EngineConfig(local_capacity=1 << 18,
                             exchange_capacity=1 << 17,
-                            out_capacity=1 << 18,
-                            table_buckets=1 << 21,
-                            residual_capacity=1 << 15,
-                            probe_rounds=3))
+                            out_capacity=1 << 18))
 
     print(f"# corpus ready ({len(corpus)/1e6:.0f} MB, {gen_s:.1f}s); "
           "warmup (compile) ...", file=sys.stderr, flush=True)

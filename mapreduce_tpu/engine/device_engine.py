@@ -202,15 +202,21 @@ class DeviceEngine:
     UPLOAD_SLABS = 12
 
     def _shard_inputs(self, chunks: np.ndarray):
-        """Pad the chunk batch to a multiple of the mesh size and place it
-        sharded over the data axis (device d gets chunks d, d+P, d+2P, ...
-        so load stays balanced and the global index rides in the payload).
+        """Pad the chunk batch to a multiple of the data-axis size and place
+        it sharded over the data axis (data-position d gets chunks d, d+P,
+        d+2P, ... so load stays balanced and the global index rides in the
+        payload).  On meshes with a model axis, each data-position's block
+        is replicated across the model-axis devices — the sharding's own
+        device->index map decides which slice every device holds, so this
+        works on any mesh shape (the round-2 version enumerated
+        ``mesh.devices.flat`` against data-axis-only block counts and
+        crashed on e.g. a 2x4 (model, data) mesh).
 
         The per-device block is shipped as several async slab transfers
         (pipelined through the host->device link) and assembled into one
         global sharded array without further copies."""
         S = chunks.shape[0]
-        k = -(-S // self.n_dev)  # chunks per device
+        k = -(-S // self.n_dev)  # chunks per data position
         # pad chunks are all-zero; the program masks their records out via
         # the n_real bound, so their content never matters
         padded = np.zeros((k * self.n_dev,) + chunks.shape[1:],
@@ -220,21 +226,22 @@ class DeviceEngine:
         order = idx.reshape(k, self.n_dev).T.reshape(-1)
         ordered = padded[order]
 
-        devices = list(self.mesh.devices.flat)
         sharding = NamedSharding(self.mesh, P(AXIS))
+        global_shape = (k * self.n_dev,) + chunks.shape[1:]
+        idx_map = sharding.addressable_devices_indices_map(global_shape)
         slabs = min(self.UPLOAD_SLABS, max(1, k))
         per = -(-k // slabs)
         futures = []  # issue EVERY transfer before waiting on any
-        for d, dev in enumerate(devices):
-            block = ordered[d * k:(d + 1) * k]
+        for dev, index in idx_map.items():
+            block = ordered[index]
             futures.append([jax.device_put(block[s * per:(s + 1) * per],
                                            dev)
-                            for s in range(slabs) if s * per < k])
+                            for s in range(slabs)
+                            if s * per < block.shape[0]])
         shards = [jnp.concatenate(parts, axis=0) if len(parts) > 1
                   else parts[0] for parts in futures]
         dev_chunks = jax.make_array_from_single_device_arrays(
-            (k * self.n_dev,) + chunks.shape[1:], sharding,
-            [jax.device_put(s, dev) for s, dev in zip(shards, devices)])
+            global_shape, sharding, shards)
         dev_idx = jax.device_put(order.astype(np.int32), sharding)
         return dev_chunks, dev_idx, np.int32(S)
 

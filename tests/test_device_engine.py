@@ -152,6 +152,13 @@ def wc_mesh():
     return make_mesh()
 
 
+def _oracle(data: bytes):
+    expected = {}
+    for w in data.split():
+        expected[w] = expected.get(w, 0) + 1
+    return expected
+
+
 def _random_text(n_words=5000, seed=1):
     rng = np.random.default_rng(seed)
     vocab = [f"w{i:03d}".encode() for i in range(200)] + [
@@ -167,10 +174,7 @@ def test_device_wordcount_equals_oracle(wc_mesh):
     data = _random_text()
     wc = DeviceWordCount(wc_mesh, chunk_len=4096)
     got = wc.count_bytes(data)
-    expected = {}
-    for w in data.split():
-        expected[w] = expected.get(w, 0) + 1
-    assert got == expected
+    assert got == _oracle(data)
 
 
 def test_device_wordcount_overflow_retry(wc_mesh):
@@ -181,12 +185,20 @@ def test_device_wordcount_overflow_retry(wc_mesh):
         config=EngineConfig(local_capacity=32, exchange_capacity=8,
                             out_capacity=32))
     got = wc.count_bytes(data)
-    expected = {}
-    for w in data.split():
-        expected[w] = expected.get(w, 0) + 1
-    assert got == expected
+    assert got == _oracle(data)
 
 
 def test_device_wordcount_empty(wc_mesh):
     wc = DeviceWordCount(wc_mesh, chunk_len=1024)
     assert wc.count_bytes(b"   \n  ") == {}
+
+
+def test_device_wordcount_mixed_mesh():
+    """The engine must run on meshes with a model axis — the dryrun's 2x4
+    (model, data) shape crashed round 2's _shard_inputs, which enumerated
+    all devices against data-axis-only block counts (MULTICHIP_r02)."""
+    mesh = make_mesh(n_data=4, n_model=2)
+    data = _random_text(n_words=3000, seed=3)
+    wc = DeviceWordCount(mesh, chunk_len=2048)
+    got = wc.count_bytes(data)
+    assert got == _oracle(data)
