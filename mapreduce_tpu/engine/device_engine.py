@@ -194,86 +194,173 @@ class DeviceEngine:
             self._compiled[key] = self._program(cfg)
         return self._compiled[key]
 
+    def _merge_program(self, cfg: EngineConfig):
+        """Program that folds W waves' per-partition uniques into one:
+        the inputs are the concatenated wave outputs ([n_dev, W*C, ...]),
+        and each device re-reduces its own partition's W partial unique
+        sets with the final monoid — no collective needed, because wave
+        outputs for partition p already live on device p."""
+        fin_op = "sum" if cfg.unit_values else cfg.reduce_op
+        C = cfg.out_capacity
+
+        def merge_dev(keys, vals, pay, valid):
+            fin = sorted_unique_reduce(keys[0], vals[0], pay[0], valid[0],
+                                       C, fin_op, unit_values=False)
+            oflow = jnp.maximum(fin.n_unique - C, 0)
+            expand = lambda a: a[None]
+            return (expand(fin.keys), expand(fin.values),
+                    expand(fin.payload), expand(fin.valid), expand(oflow))
+
+        sharded = P(AXIS)
+        fn = jax.shard_map(merge_dev, mesh=self.mesh,
+                           in_specs=(sharded,) * 4,
+                           out_specs=(sharded,) * 5)
+        return jax.jit(fn)
+
+    def _get_merge(self, cfg: EngineConfig):
+        key = ("merge",) + cfg.cache_key()
+        if key not in self._compiled:
+            self._compiled[key] = self._merge_program(cfg)
+        return self._compiled[key]
+
     # -- host driver -------------------------------------------------------
 
-    #: host->device transfers per device: a single giant device_put was
-    #: measured 4x slower than ~8-16 pipelined slab transfers on the
-    #: tunnelled v5e (82s vs 21s for 375MB)
-    UPLOAD_SLABS = 12
+    #: target host bytes per pipeline wave (auto wave count); ~48MB keeps
+    #: each wave's transfer ≈ its compute on the tunnelled v5e link
+    WAVE_BYTES = 48 << 20
+    MAX_WAVES = 8
 
-    def _shard_inputs(self, chunks: np.ndarray):
-        """Pad the chunk batch to a multiple of the data-axis size and place
-        it sharded over the data axis (data-position d gets chunks d, d+P,
-        d+2P, ... so load stays balanced and the global index rides in the
-        payload).  On meshes with a model axis, each data-position's block
-        is replicated across the model-axis devices — the sharding's own
-        device->index map decides which slice every device holds, so this
-        works on any mesh shape (the round-2 version enumerated
-        ``mesh.devices.flat`` against data-axis-only block counts and
-        crashed on e.g. a 2x4 (model, data) mesh).
+    def _auto_waves(self, chunks: np.ndarray) -> int:
+        by_bytes = max(1, round(chunks.nbytes / self.WAVE_BYTES))
+        by_rows = max(1, chunks.shape[0] // self.n_dev)
+        return min(self.MAX_WAVES, by_bytes, by_rows)
 
-        The per-device block is shipped as several async slab transfers
-        (pipelined through the host->device link) and assembled into one
-        global sharded array without further copies."""
+    def _shard_inputs(self, chunks: np.ndarray, waves: int = 1):
+        """Split the chunk batch into *waves* equal groups, each placed
+        sharded over the data axis as one plain ``jax.device_put`` with a
+        ``NamedSharding`` — contiguous per-device blocks, so full waves are
+        zero-copy numpy views of the caller's array (only the final
+        partial wave pays a pad copy), and JAX's own device->slice map
+        handles model-axis replication on any mesh shape.
+
+        Returns ``(wave_list, n_real)`` where each wave entry is
+        ``(dev_chunks [k*n_dev, ...], dev_idx [k*n_dev])`` with *global*
+        chunk indices (so payload byte offsets stay corpus-global across
+        waves) and ``n_real`` is the true chunk count — indices beyond it
+        are padding whose records the program masks out.
+
+        Each wave's put is issued from a worker thread: ``device_put``
+        pays a synchronous host staging copy before the DMA, so issuing
+        the waves from one thread would serialize ~hundreds of MB of
+        memcpy ahead of the first compute dispatch.  The returned wave
+        entries hold futures; callers resolve them in order (round 2's
+        12-slab assembly plus two full-corpus host copies was strictly
+        slower than this on every link condition measured)."""
+        import concurrent.futures as cf
+
         S = chunks.shape[0]
-        k = -(-S // self.n_dev)  # chunks per data position
-        # pad chunks are all-zero; the program masks their records out via
-        # the n_real bound, so their content never matters
-        padded = np.zeros((k * self.n_dev,) + chunks.shape[1:],
-                          dtype=chunks.dtype)
-        padded[:S] = chunks
-        idx = np.arange(k * self.n_dev, dtype=np.int32)
-        order = idx.reshape(k, self.n_dev).T.reshape(-1)
-        ordered = padded[order]
-
+        k = -(-S // (waves * self.n_dev))  # chunks per device per wave
+        rpw = k * self.n_dev               # rows per wave
+        waves = -(-S // rpw)  # drop trailing waves that would be all-pad
         sharding = NamedSharding(self.mesh, P(AXIS))
-        global_shape = (k * self.n_dev,) + chunks.shape[1:]
-        idx_map = sharding.addressable_devices_indices_map(global_shape)
-        slabs = min(self.UPLOAD_SLABS, max(1, k))
-        per = -(-k // slabs)
-        futures = []  # issue EVERY transfer before waiting on any
-        for dev, index in idx_map.items():
-            block = ordered[index]
-            futures.append([jax.device_put(block[s * per:(s + 1) * per],
-                                           dev)
-                            for s in range(slabs)
-                            if s * per < block.shape[0]])
-        shards = [jnp.concatenate(parts, axis=0) if len(parts) > 1
-                  else parts[0] for parts in futures]
-        dev_chunks = jax.make_array_from_single_device_arrays(
-            global_shape, sharding, shards)
-        dev_idx = jax.device_put(order.astype(np.int32), sharding)
-        return dev_chunks, dev_idx, np.int32(S)
+
+        def put_wave(w: int):
+            lo = w * rpw
+            if lo + rpw <= S:
+                block = chunks[lo:lo + rpw]  # zero-copy view
+            else:  # final wave: pad with zero chunks (masked via n_real)
+                block = np.zeros((rpw,) + chunks.shape[1:],
+                                 dtype=chunks.dtype)
+                if lo < S:
+                    block[:S - lo] = chunks[lo:]
+            dev_chunks = jax.device_put(block, sharding)
+            idx = np.arange(lo, lo + rpw, dtype=np.int32)
+            dev_idx = jax.device_put(idx, sharding)
+            return dev_chunks, dev_idx
+
+        if waves == 1:
+            return [put_wave(0)], np.int32(S)
+        pool = cf.ThreadPoolExecutor(max_workers=min(waves, 8))
+        try:
+            wave_list = [pool.submit(put_wave, w) for w in range(waves)]
+        finally:
+            pool.shutdown(wait=False)
+        return wave_list, np.int32(S)
 
     def run(self, chunks: np.ndarray, max_retries: int = 3,
-            timings: dict = None) -> DeviceResult:
+            timings: dict = None, waves: int = None) -> DeviceResult:
         """Execute over *chunks* ([S, ...] host array, sharded over the
         mesh), growing capacities until no stage overflowed.
 
-        Pass ``timings={}`` to receive per-stage wall seconds (upload /
-        compute / readback) — the device-path analogue of the host
-        server's per-phase stats (server.lua:555-600)."""
+        *waves* (default: auto from input size) pipelines the host->device
+        link against the TPU: the input is shipped as several sharded
+        transfers, each wave's map/sort/shuffle program is dispatched
+        asynchronously as soon as its transfer is issued, and a final
+        on-device program folds the waves' per-partition uniques.  Upload
+        of wave i+1 thus overlaps compute of wave i (the round-2 engine
+        serialized a single monolithic upload before any compute).
+
+        Pass ``timings={}`` to receive per-stage wall seconds — the
+        device-path analogue of the host server's per-phase stats
+        (server.lua:555-600).  With waves > 1 the stages overlap:
+        ``upload_s`` is the wall time until every input shard was
+        resident, ``compute_s`` the remaining tail until all programs
+        finished."""
         import time
 
+        W = self._auto_waves(chunks) if waves is None else max(1, waves)
         cfg = self.config
-        # input transfer does not depend on capacities: pay it once, not
+        t_start = time.time()
+        # input transfer does not depend on capacities: issue it once, not
         # once per retry
-        t0 = time.time()
-        flat_chunks, flat_idx, n_real = self._shard_inputs(chunks)
-        jax.block_until_ready(flat_chunks)
-        t_upload = time.time() - t0
+        wave_inputs, n_real = self._shard_inputs(chunks, W)
+        W = len(wave_inputs)  # may have been clamped to data-bearing waves
+        resolved = {}
+
+        def wave(w):
+            if w not in resolved:
+                wi = wave_inputs[w]
+                resolved[w] = wi if isinstance(wi, tuple) else wi.result()
+            return resolved[w]
+
+        t_upload = None  # measured once: retries reuse resident inputs
+        t_compute = 0.0
         for _ in range(max_retries + 1):
             fn = self._get_compiled(cfg)
             t0 = time.time()
-            keys, vals, pay, valid, oflow = fn(flat_chunks, flat_idx,
-                                               n_real)
-            # the (tiny) overflow readback forces program completion
-            oflow_h = np.asarray(oflow)
-            t_compute = time.time() - t0
-            total_oflow = int(oflow_h.sum())
+            # dispatch each wave once its input is RESIDENT: wave w's
+            # program runs while waves w+1.. still stream in background
+            # threads, and no program ever queues against an in-flight
+            # transfer (measured to throttle the tunnelled link)
+            outs = []
+            for w in range(W):
+                ci, ii = wave(w)
+                jax.block_until_ready(ci)
+                outs.append(fn(ci, ii, n_real))
+            oflows = [o[4] for o in outs]
+            if len(outs) > 1:
+                merge = self._get_merge(cfg)
+                cat = lambda i: jnp.concatenate([o[i] for o in outs],
+                                                axis=1)
+                keys, vals, pay, valid, m_oflow = merge(
+                    cat(0), cat(1), cat(2), cat(3))
+                oflows.append(m_oflow)
+            else:
+                keys, vals, pay, valid, _ = outs[0]
+            jax.block_until_ready([ci for ci, _ in resolved.values()])
+            if t_upload is None:
+                # from t_start: includes _shard_inputs' staging copies
+                t_upload = time.time() - t_start
+                compute_from = time.time()
+            else:
+                compute_from = t0
+            # the (tiny) overflow readbacks force program completion
+            total_oflow = sum(int(np.asarray(o).sum()) for o in oflows)
+            t_compute += time.time() - compute_from
             if total_oflow == 0:
                 break
             cfg = cfg.doubled()
+        del wave_inputs, resolved, outs
         # sliced readback: only the live prefix of each partition's
         # capacity-padded result crosses the (slow) device->host link
         t0 = time.time()
@@ -284,7 +371,9 @@ class DeviceEngine:
                               take(valid), total_oflow)
         t_readback = time.time() - t0
         if timings is not None:
+            timings["waves"] = W
             timings["upload_s"] = round(t_upload, 3)
             timings["compute_s"] = round(t_compute, 3)
             timings["readback_s"] = round(t_readback, 3)
+            timings["total_s"] = round(time.time() - t_start, 3)
         return result

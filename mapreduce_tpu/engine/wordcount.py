@@ -90,24 +90,40 @@ class DeviceWordCount:
         return next(reversed(self._engines.values())) if self._engines \
             else self._engine_for(self.chunk_len)
 
-    def count_bytes(self, data: bytes) -> Dict[bytes, int]:
+    def count_bytes(self, data: bytes, timings: Optional[dict] = None,
+                    waves: Optional[int] = None) -> Dict[bytes, int]:
         """Count whitespace-separated words of *data* (the user surface:
         same answer as examples/naive.wordcount on the same bytes).
 
         Counts are int32 end-to-end: a single key is exact up to 2**31-1
         occurrences (~8 GB of one repeated 3-byte word) — beyond that the
-        count wraps.  Corpora near that bound need a wider value lane."""
+        count wraps.  Corpora near that bound need a wider value lane.
+
+        Pass ``timings={}`` to receive per-stage wall seconds (split /
+        upload / compute / readback / materialize) — the device-path
+        analogue of the reference server's per-phase stats report
+        (server.lua:555-600)."""
+        import time
+
+        t0 = time.time()
         n_chunks = max(1, -(-len(data) // self.chunk_len))
         # round chunks up to a mesh multiple so every device participates
         n_dev = self.mesh.shape["data"]
         n_chunks = -(-n_chunks // n_dev) * n_dev
         chunks, L = shard_text(data, n_chunks, pad_multiple=self.config.tile)
-        result = self._engine_for(L).run(chunks)
+        t_split = time.time() - t0
+        result = self._engine_for(L).run(chunks, timings=timings,
+                                         waves=waves)
         if result.overflow:
             raise RuntimeError(
                 f"wordcount overflowed capacities by {result.overflow} "
                 "rows even after retries; raise EngineConfig capacities")
-        return materialize_counts(chunks, result)
+        t0 = time.time()
+        out = materialize_counts(chunks, result)
+        if timings is not None:
+            timings["split_s"] = round(t_split, 3)
+            timings["materialize_s"] = round(time.time() - t0, 3)
+        return out
 
     def count_files(self, paths) -> Dict[bytes, int]:
         parts = []

@@ -218,6 +218,7 @@ def shard_text(data: bytes, num_shards: int,
     the reference gets from line-aligned input splits (README.md:43-45).
     """
     n = len(data)
+    flat = np.frombuffer(data, dtype=np.uint8)  # zero-copy
     bounds = [0]
     for s in range(1, num_shards):
         cut = min(n, s * n // num_shards)
@@ -226,10 +227,10 @@ def shard_text(data: bytes, num_shards: int,
             cut += 1
         bounds.append(cut)
     bounds.append(n)
-    parts = [data[bounds[i]:bounds[i + 1]] for i in range(num_shards)]
-    L = max(1, max(len(p) for p in parts))
+    L = max(1, max(bounds[i + 1] - bounds[i] for i in range(num_shards)))
     L = ((L + pad_multiple - 1) // pad_multiple) * pad_multiple
     arr = np.full((num_shards, L), ord(" "), dtype=np.uint8)
-    for i, p in enumerate(parts):
-        arr[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
+    for i in range(num_shards):
+        lo, hi = bounds[i], bounds[i + 1]
+        arr[i, :hi - lo] = flat[lo:hi]  # single memcpy per shard
     return arr, L

@@ -1,0 +1,33 @@
+"""A/B at the same instant: fresh random vs fresh text vs repeated content."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from mapreduce_tpu.parallel import make_mesh
+import bench
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+MB = 1 << 20
+
+corpus = bench.make_corpus(13_000_000, 480_000)
+text = np.frombuffer(corpus, dtype=np.uint8)[:96 * MB].reshape(24, 4 * MB)
+
+def put(arr, label):
+    t0 = time.time()
+    out = jax.device_put(arr, sh)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    print(f"{label:40s} {dt:6.2f}s {arr.nbytes/MB/dt:7.1f} MB/s", flush=True)
+    del out
+
+for rep in range(3):
+    rnd = np.random.default_rng(None).integers(0, 255, size=(24, 4 * MB),
+                                               dtype=np.uint8)
+    put(rnd, f"rep{rep} fresh random 96MB")
+    put(text, f"rep{rep} same text 96MB")
+    t2 = (text.astype(np.int16) + rep + 1).astype(np.uint8)  # new content
+    put(t2, f"rep{rep} perturbed text 96MB")
+    zeros = np.zeros((24, 4 * MB), np.uint8)
+    put(zeros, f"rep{rep} zeros 96MB")

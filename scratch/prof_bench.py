@@ -1,0 +1,31 @@
+"""Full-scale bench with per-stage timings to find the real bottleneck."""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+import jax
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.parallel import make_mesh
+
+t0 = time.time()
+corpus = bench.make_corpus()
+print(f"corpus gen {time.time()-t0:.1f}s, {len(corpus)/1e6:.0f} MB")
+
+mesh = make_mesh()
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+t0 = time.time()
+tm = {}
+counts = wc.count_bytes(corpus, timings=tm)
+print(f"warmup total {time.time()-t0:.1f}s timings={tm}")
+for rep in range(2):
+    t0 = time.time()
+    tm = {}
+    counts = wc.count_bytes(corpus, timings=tm)
+    print(f"run{rep} total {time.time()-t0:.2f}s timings={tm}")
+print(len(counts), "uniques", sum(counts.values()), "total")

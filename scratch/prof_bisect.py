@@ -1,0 +1,54 @@
+"""Bisect: what makes _shard_inputs transfers slow vs bare puts?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+MB = 1 << 20
+corpus = bench.make_corpus()
+chunks, L = shard_text(corpus, 94, pad_multiple=512)
+print("chunks", chunks.shape, flush=True)
+
+def t(label, fnc, reps=2):
+    for r in range(reps):
+        t0 = time.time(); out = fnc(); jax.block_until_ready(out)
+        print(f"{label:44s} {time.time()-t0:6.2f}s", flush=True)
+        del out
+
+# 1: 8 puts of 12-row views of shard_text chunks (incl. tail handling)
+def puts_shard_text():
+    outs = []
+    for w in range(8):
+        lo = w * 12
+        if lo + 12 <= 94:
+            block = chunks[lo:lo + 12]
+        else:
+            block = np.zeros((12,) + chunks.shape[1:], chunks.dtype)
+            block[:94 - lo] = chunks[lo:]
+        outs.append(jax.device_put(block, sh))
+    return outs
+t("1: 12-row views of shard_text arr", puts_shard_text)
+
+# 2: same rows but from a flat frombuffer reshape (prof_threads style)
+flat = np.frombuffer(corpus, dtype=np.uint8)
+rows = flat.size // L
+c2 = flat[:rows * L].reshape(rows, L)
+def puts_frombuffer():
+    return [jax.device_put(c2[w * 11:(w + 1) * 11], sh) for w in range(8)]
+t("2: 11-row views of frombuffer arr", puts_frombuffer)
+
+# 3: copy of shard_text array (fresh allocation, same content)
+c3 = chunks.copy()
+def puts_copy():
+    return [jax.device_put(c3[w * 12:(w + 1) * 12][: 94 - w * 12 if w == 7 else 12], sh) for w in range(8)]
+t("3: views of chunks.copy()", puts_copy)
+
+# 4: one put of whole chunks
+t("4: single put whole chunks", lambda: jax.device_put(chunks, sh))

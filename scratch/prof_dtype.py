@@ -1,0 +1,35 @@
+"""In a post-engine-run (degraded) process: uint8 vs int32-view puts."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+corpus = bench.make_corpus()
+chunks, L = shard_text(corpus, 94, pad_multiple=512)
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+eng = wc._engine_for(L)
+fn = eng._get_compiled(eng.config)
+out = fn(jax.device_put(chunks, sh),
+         jax.device_put(np.arange(94, dtype=np.int32), sh), np.int32(94))
+jax.block_until_ready(out[4]); del out
+print("engine ran (process now in degraded-transfer regime)", flush=True)
+
+c32 = chunks.view(np.int32)
+c16 = chunks.view(np.uint16)
+for rep in range(3):
+    t0 = time.time(); o = jax.device_put(chunks, sh); jax.block_until_ready(o); del o
+    print(f"rep{rep} uint8  {time.time()-t0:6.2f}s", flush=True)
+    t0 = time.time(); o = jax.device_put(c32, sh); jax.block_until_ready(o); del o
+    print(f"rep{rep} int32  {time.time()-t0:6.2f}s", flush=True)
+    t0 = time.time(); o = jax.device_put(c16, sh); jax.block_until_ready(o); del o
+    print(f"rep{rep} uint16 {time.time()-t0:6.2f}s", flush=True)

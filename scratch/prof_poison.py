@@ -1,0 +1,47 @@
+"""Does executing the engine program degrade subsequent transfer speed?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+corpus = bench.make_corpus()
+chunks, L = shard_text(corpus, 94, pad_multiple=512)
+
+def t_put(label):
+    t0 = time.time()
+    out = jax.device_put(chunks, sh)
+    jax.block_until_ready(out)
+    print(f"{label:44s} {time.time()-t0:6.2f}s", flush=True)
+    return out
+
+t_put("put before any engine run")
+dev = t_put("put again")
+
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+eng = wc._engine_for(L)
+fn = eng._get_compiled(eng.config)
+t0 = time.time()
+out = fn(dev, jax.device_put(np.arange(94, dtype=np.int32), sh), np.int32(94))
+jax.block_until_ready(out[4])
+print(f"engine program ran in {time.time()-t0:6.2f}s (incl compile)", flush=True)
+
+t_put("put right after engine run")
+del out
+t_put("put after deleting outputs")
+time.sleep(5)
+t_put("put after 5s sleep")
+t0 = time.time()
+out2 = fn(dev, jax.device_put(np.arange(94, dtype=np.int32), sh), np.int32(94))
+jax.block_until_ready(out2[4])
+print(f"engine program (warm) ran in {time.time()-t0:6.2f}s", flush=True)
+t_put("put right after warm engine run")

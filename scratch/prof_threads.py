@@ -1,0 +1,32 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import concurrent.futures as cf
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from mapreduce_tpu.parallel import make_mesh
+import bench
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+MB = 1 << 20
+corpus = bench.make_corpus()
+flat = np.frombuffer(corpus, dtype=np.uint8); rows = flat.size // (4 * MB) // 8 * 8; chunks = flat[:rows * 4 * MB].reshape(rows, 4 * MB)
+
+def seq(c):
+    outs = [jax.device_put(c[w * (len(c) // 8):(w + 1) * (len(c) // 8)], sh) for w in range(8)]
+    jax.block_until_ready(outs)
+
+def thr(c, n):
+    with cf.ThreadPoolExecutor(max_workers=n) as ex:
+        outs = list(ex.map(
+            lambda w: jax.device_put(c[w * (len(c) // 8):(w + 1) * (len(c) // 8)], sh), range(8)))
+    jax.block_until_ready(outs)
+
+for rep in range(3):
+    c = (chunks.astype(np.int16) + rep * 3).astype(np.uint8)   # fresh content
+    t0 = time.time(); seq(c); print(f"rep{rep} seq     {time.time()-t0:6.2f}s", flush=True)
+    c = (chunks.astype(np.int16) + rep * 3 + 1).astype(np.uint8)
+    t0 = time.time(); thr(c, 8); print(f"rep{rep} thr8    {time.time()-t0:6.2f}s", flush=True)
+    c = (chunks.astype(np.int16) + rep * 3 + 2).astype(np.uint8)
+    t0 = time.time(); thr(c, 2); print(f"rep{rep} thr2    {time.time()-t0:6.2f}s", flush=True)

@@ -1,0 +1,75 @@
+"""Profile host->device upload throughput on the real chip.
+
+Questions: (1) what MB/s does the tunnelled link sustain for one big
+device_put, (2) does splitting into N async slabs help, (3) do Python
+threads issuing device_put concurrently help, (4) does the on-device
+concatenate cost matter.  Drives the DeviceEngine.UPLOAD_SLABS choice and
+the wave-pipeline design (upload of wave i+1 overlapped with compute of
+wave i).
+"""
+
+import concurrent.futures as cf
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MB = 1 << 20
+SIZE = 256 * MB
+
+dev = jax.devices()[0]
+print("platform:", dev.platform)
+data = np.random.default_rng(0).integers(0, 255, size=SIZE,
+                                         dtype=np.uint8)
+
+
+def timed(label, fn):
+    t0 = time.time()
+    out = fn()
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    print(f"{label:42s} {dt:7.2f}s  {SIZE / MB / dt:7.1f} MB/s")
+    return dt
+
+
+# 1) one giant transfer
+timed("single device_put", lambda: jax.device_put(data, dev))
+
+# 2) N slabs, async dispatch then concat
+for n in (4, 8, 16, 32, 64):
+    per = SIZE // n
+
+    def slabs(n=n, per=per):
+        parts = [jax.device_put(data[i * per:(i + 1) * per], dev)
+                 for i in range(n)]
+        return jnp.concatenate(parts)
+
+    timed(f"{n} slabs async + concat", slabs)
+
+# 3) N slabs via thread pool
+for n in (8, 16, 32):
+    per = SIZE // n
+
+    def threaded(n=n, per=per):
+        with cf.ThreadPoolExecutor(max_workers=n) as ex:
+            parts = list(ex.map(
+                lambda i: jax.device_put(data[i * per:(i + 1) * per], dev),
+                range(n)))
+        return jnp.concatenate(parts)
+
+    timed(f"{n} slabs threaded + concat", threaded)
+
+# 4) slabs WITHOUT the concat (what pure transfer costs)
+for n in (16,):
+    per = SIZE // n
+
+    def noconcat(n=n, per=per):
+        return [jax.device_put(data[i * per:(i + 1) * per], dev)
+                for i in range(n)]
+
+    timed(f"{n} slabs async, no concat", noconcat)
+
+# 5) does dtype matter? (uint8 vs int32 view, same bytes)
+data32 = data.view(np.int32)
+timed("single device_put int32 view", lambda: jax.device_put(data32, dev))

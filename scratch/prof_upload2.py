@@ -1,0 +1,47 @@
+"""Round 2: combine the winners (int32 view x threaded slabs), repeat
+trials, and measure overlap potential (upload while a compute runs)."""
+
+import concurrent.futures as cf
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MB = 1 << 20
+SIZE = 256 * MB
+
+dev = jax.devices()[0]
+data = np.random.default_rng(0).integers(0, 255, size=SIZE,
+                                         dtype=np.uint8)
+data32 = data.view(np.int32)
+
+
+def timed(label, fn, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        out = fn()
+        jax.block_until_ready(out)
+        best = min(best, time.time() - t0)
+        del out
+    print(f"{label:46s} {best:7.2f}s  {SIZE / MB / best:7.1f} MB/s")
+    return best
+
+
+timed("single put uint8", lambda: jax.device_put(data, dev))
+timed("single put int32 view", lambda: jax.device_put(data32, dev))
+
+pool = cf.ThreadPoolExecutor(max_workers=32)
+
+for arr, tag in ((data, "uint8"), (data32, "int32")):
+    for n in (4, 8, 16, 32):
+        per = arr.size // n
+
+        def threaded(arr=arr, n=n, per=per):
+            return list(pool.map(
+                lambda i: jax.device_put(arr[i * per:(i + 1) * per], dev),
+                range(n)))
+
+        timed(f"{n} slabs threaded no-concat {tag}", threaded)
+pool.shutdown()

@@ -1,0 +1,41 @@
+"""Why does upload degrade across bench runs? Test: fresh vs reused host
+arrays, holding vs freeing device buffers, 393MB scale."""
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MB = 1 << 20
+SIZE = 393 * MB
+dev = jax.devices()[0]
+
+def put(arr, label):
+    t0 = time.time()
+    out = jax.device_put(arr, dev)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    print(f"{label:44s} {dt:6.2f}s {arr.nbytes / MB / dt:7.1f} MB/s",
+          flush=True)
+    return out
+
+base = np.random.default_rng(0).integers(0, 255, size=SIZE, dtype=np.uint8)
+
+# A: same array, repeated, dropping device buffer each time
+for i in range(3):
+    out = put(base, f"A{i} reused host arr, drop dev buf")
+    del out
+
+# B: fresh host copy each time (like _shard_inputs building padded/ordered)
+for i in range(3):
+    fresh = base.copy()
+    out = put(fresh, f"B{i} fresh host copy, drop dev buf")
+    del out, fresh
+
+# C: fresh 2D + fancy-index permutation (exactly what _shard_inputs does)
+for i in range(3):
+    chunks = base.reshape(94 - 1 + 1, -1)[: 93 * 1]  # ~389MB 2D
+    k = chunks.shape[0]
+    order = np.arange(k).reshape(k, 1).T.reshape(-1)
+    ordered = chunks[np.random.permutation(k)]
+    out = put(ordered, f"C{i} fresh permuted 2D")
+    del out, ordered

@@ -1,0 +1,42 @@
+"""Reproduce the bench's degrading upload: call _shard_inputs repeatedly
+on the real 393MB chunk batch, and compare against a plain sharded
+device_put of the same array."""
+import time
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+corpus = bench.make_corpus()
+mesh = make_mesh()
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+n_chunks = max(1, -(-len(corpus) // wc.chunk_len))
+n_dev = mesh.shape["data"]
+n_chunks = -(-n_chunks // n_dev) * n_dev
+chunks, L = shard_text(corpus, n_chunks, pad_multiple=wc.config.tile)
+print("chunks", chunks.shape, chunks.nbytes / 1e6, "MB", flush=True)
+eng = wc._engine_for(L)
+
+for i in range(4):
+    t0 = time.time()
+    a, b, c = eng._shard_inputs(chunks)
+    jax.block_until_ready(a)
+    print(f"_shard_inputs {i}: {time.time()-t0:6.2f}s", flush=True)
+    del a, b
+
+sh = NamedSharding(mesh, P("data"))
+for i in range(3):
+    t0 = time.time()
+    a = jax.device_put(chunks, sh)
+    jax.block_until_ready(a)
+    print(f"plain sharded device_put {i}: {time.time()-t0:6.2f}s", flush=True)
+    del a

@@ -1,0 +1,61 @@
+"""Isolate: view-vs-copy device_put, and transfers interleaved with
+dispatched compute (the wave pipeline pattern)."""
+import sys, os
+sys.path.insert(0, "/root/repo")
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from mapreduce_tpu.parallel import make_mesh
+
+MB = 1 << 20
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+W = 8
+
+def fresh():
+    return np.random.default_rng(None).integers(
+        0, 255, size=(96, 4 * MB), dtype=np.uint8)  # 384MB
+
+def timed(label, fn):
+    t0 = time.time()
+    fn()
+    dt = time.time() - t0
+    print(f"{label:46s} {dt:6.2f}s {384 / dt:7.1f} MB/s", flush=True)
+
+# A: 8 sharded puts of contiguous VIEWS, no compute
+def views_only():
+    big = fresh()
+    outs = [jax.device_put(big[w * 12:(w + 1) * 12], sh) for w in range(W)]
+    jax.block_until_ready(outs)
+timed("A 8 sharded puts of views", views_only)
+timed("A2 8 sharded puts of views", views_only)
+
+# B: same but np.ascontiguousarray copies
+def copies():
+    big = fresh()
+    outs = [jax.device_put(big[w * 12:(w + 1) * 12].copy(), sh)
+            for w in range(W)]
+    jax.block_until_ready(outs)
+timed("B 8 sharded puts of copies", copies)
+
+# C: one put of the whole array
+def one_put():
+    big = fresh()
+    jax.block_until_ready(jax.device_put(big, sh))
+timed("C single sharded put 384MB", one_put)
+timed("C2 single sharded put 384MB", one_put)
+
+# D: views interleaved with a dispatched reduction per wave
+red = jax.jit(lambda x: jnp.sum(x.astype(jnp.int32)))
+def interleaved():
+    big = fresh()
+    outs = []
+    for w in range(W):
+        d = jax.device_put(big[w * 12:(w + 1) * 12], sh)
+        outs.append(red(d))
+    jax.block_until_ready(outs)
+_ = red(jax.device_put(fresh()[:12], sh))  # warm compile
+timed("D views + dispatched compute per wave", interleaved)
+timed("D2 views + dispatched compute per wave", interleaved)

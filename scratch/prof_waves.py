@@ -1,0 +1,56 @@
+"""Serialize the wave pipeline to attribute time: per-wave upload (blocked),
+per-wave compute (blocked), merge."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+corpus = bench.make_corpus()
+mesh = make_mesh()
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+n_chunks = -(-len(corpus) // wc.chunk_len)
+chunks, L = shard_text(corpus, n_chunks, pad_multiple=wc.config.tile)
+print("chunks", chunks.shape, flush=True)
+eng = wc._engine_for(L)
+cfg = eng.config
+fn = eng._get_compiled(cfg)
+
+W = 8
+wave_inputs, n_real = eng._shard_inputs(chunks, W)
+jax.block_until_ready([c for c, _ in wave_inputs])
+print("all inputs resident (warm cache?)", flush=True)
+
+# warm compile
+out = fn(*wave_inputs[0], n_real)
+jax.block_until_ready(out[4])
+print("compiled", flush=True)
+
+# serialized timing, fresh inputs
+del wave_inputs, out
+for trial in range(2):
+    t_all = time.time()
+    wave_inputs, n_real = eng._shard_inputs(chunks, W)
+    up = cp = 0.0
+    outs = []
+    for ci, ii in wave_inputs:
+        t0 = time.time(); jax.block_until_ready(ci); up += time.time() - t0
+        t0 = time.time(); o = fn(ci, ii, n_real)
+        jax.block_until_ready(o[4]); cp += time.time() - t0
+        outs.append(o)
+    merge = eng._get_merge(cfg)
+    cat = lambda i: jnp.concatenate([o[i] for o in outs], axis=1)
+    t0 = time.time()
+    m = merge(cat(0), cat(1), cat(2), cat(3))
+    jax.block_until_ready(m[0]); mg = time.time() - t0
+    print(f"trial{trial}: upload {up:.2f}s compute {cp:.2f}s merge {mg:.2f}s "
+          f"wall {time.time()-t_all:.2f}s", flush=True)
+    del wave_inputs, outs, m

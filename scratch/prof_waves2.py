@@ -1,0 +1,58 @@
+"""Timestamp every event in the wave pipeline to find the 20s."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+corpus = bench.make_corpus()
+mesh = make_mesh()
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+n_chunks = -(-len(corpus) // wc.chunk_len)
+chunks, L = shard_text(corpus, n_chunks, pad_multiple=wc.config.tile)
+eng = wc._engine_for(L)
+cfg = eng.config
+fn = eng._get_compiled(cfg)
+merge = eng._get_merge(cfg)
+
+# warm everything
+wi, n_real = eng._shard_inputs(chunks, 8)
+outs = [fn(*(w if isinstance(w, tuple) else w.result()), n_real) for w in wi]
+cat = lambda i: jnp.concatenate([o[i] for o in outs], axis=1)
+m = merge(cat(0), cat(1), cat(2), cat(3))
+jax.block_until_ready(m[0])
+del wi, outs, m
+print("warm", flush=True)
+
+for trial in range(2):
+    T0 = time.time()
+    ts = lambda: f"{time.time()-T0:6.2f}"
+    wave_inputs, n_real = eng._shard_inputs(chunks, 8)
+    print(f"[{ts()}] puts submitted", flush=True)
+    outs = []
+    resolved = []
+    for w in range(8):
+        wi_ = wave_inputs[w]
+        ci, ii = wi_ if isinstance(wi_, tuple) else wi_.result()
+        print(f"[{ts()}] wave{w} put returned", flush=True)
+        o = fn(ci, ii, n_real)
+        print(f"[{ts()}] wave{w} dispatched", flush=True)
+        outs.append(o); resolved.append(ci)
+    m = merge(*[jnp.concatenate([o[i] for o in outs], axis=1)
+                for i in range(4)])
+    print(f"[{ts()}] merge dispatched", flush=True)
+    jax.block_until_ready(resolved)
+    print(f"[{ts()}] inputs ready", flush=True)
+    for w, o in enumerate(outs):
+        jax.block_until_ready(o[4])
+        print(f"[{ts()}] wave{w} compute done", flush=True)
+    jax.block_until_ready(m[0])
+    print(f"[{ts()}] merge done", flush=True)
+    del wave_inputs, outs, m, resolved

@@ -1,0 +1,58 @@
+"""Variant A: dispatch each wave only after its input is resident.
+Variant B: all dispatches up front (current run()).
+Variant C: pure transfers, no compute (control)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+corpus = bench.make_corpus()
+mesh = make_mesh()
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+n_chunks = -(-len(corpus) // wc.chunk_len)
+chunks, L = shard_text(corpus, n_chunks, pad_multiple=wc.config.tile)
+eng = wc._engine_for(L)
+fn = eng._get_compiled(eng.config)
+
+wi, n_real = eng._shard_inputs(chunks, 8)
+outs = [fn(*(w if isinstance(w, tuple) else w.result()), n_real) for w in wi]
+jax.block_until_ready([o[4] for o in outs])
+del wi, outs
+print("warm", flush=True)
+
+def variant_A():
+    wave_inputs, nr = eng._shard_inputs(chunks, 8)
+    outs = []
+    for w in range(8):
+        ci, ii = wave_inputs[w] if isinstance(wave_inputs[w], tuple) \
+            else wave_inputs[w].result()
+        jax.block_until_ready(ci)          # input resident FIRST
+        outs.append(fn(ci, ii, nr))        # then dispatch
+    jax.block_until_ready([o[4] for o in outs])
+
+def variant_B():
+    wave_inputs, nr = eng._shard_inputs(chunks, 8)
+    outs = [fn(*(w if isinstance(w, tuple) else w.result()), nr)
+            for w in wave_inputs]
+    jax.block_until_ready([o[4] for o in outs])
+
+def variant_C():
+    wave_inputs, nr = eng._shard_inputs(chunks, 8)
+    arrs = [w if isinstance(w, tuple) else w.result()
+            for w in wave_inputs]
+    jax.block_until_ready([a[0] for a in arrs])
+
+for trial in range(2):
+    for name, v in (("C transfers only", variant_C),
+                    ("A dispatch-after-ready", variant_A),
+                    ("B dispatch-up-front", variant_B)):
+        t0 = time.time(); v()
+        print(f"trial{trial} {name:24s} {time.time()-t0:6.2f}s", flush=True)

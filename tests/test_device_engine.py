@@ -193,6 +193,29 @@ def test_device_wordcount_empty(wc_mesh):
     assert wc.count_bytes(b"   \n  ") == {}
 
 
+def test_device_wordcount_wave_pipeline(wc_mesh):
+    """waves > 1 splits the input into pipelined upload/compute waves with
+    an on-device merge of the per-partition uniques; the answer must be
+    identical to the single-wave run and the oracle."""
+    data = _random_text(n_words=8000, seed=4)
+    wc = DeviceWordCount(wc_mesh, chunk_len=1024)
+    tm = {}
+    got = wc.count_bytes(data, timings=tm, waves=3)
+    assert tm["waves"] == 3
+    assert got == _oracle(data)
+
+
+def test_device_wordcount_wave_pipeline_overflow_retry(wc_mesh):
+    """Capacity doubling must also work across a multi-wave pipeline."""
+    data = _random_text(n_words=4000, seed=5)
+    wc = DeviceWordCount(
+        wc_mesh, chunk_len=1024,
+        config=EngineConfig(local_capacity=32, exchange_capacity=8,
+                            out_capacity=32))
+    got = wc.count_bytes(data, waves=2)
+    assert got == _oracle(data)
+
+
 def test_device_wordcount_mixed_mesh():
     """The engine must run on meshes with a model axis — the dryrun's 2x4
     (model, data) shape crashed round 2's _shard_inputs, which enumerated
@@ -200,5 +223,5 @@ def test_device_wordcount_mixed_mesh():
     mesh = make_mesh(n_data=4, n_model=2)
     data = _random_text(n_words=3000, seed=3)
     wc = DeviceWordCount(mesh, chunk_len=2048)
-    got = wc.count_bytes(data)
+    got = wc.count_bytes(data, waves=2)  # wave merge on the mixed mesh too
     assert got == _oracle(data)
