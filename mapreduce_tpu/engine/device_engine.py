@@ -71,10 +71,12 @@ class EngineConfig:
                        tile_records=min(self.tile_records * 2, self.tile))
 
     def cache_key(self):
-        op = self.reduce_op
+        # the op object itself is part of the key: keeping it in the
+        # compiled-program cache holds a strong reference, so a collected
+        # lambda's id can never be reused to hit a stale program
         return (self.local_capacity, self.exchange_capacity,
                 self.out_capacity, self.tile, self.tile_records,
-                op if isinstance(op, str) else id(op), self.unit_values)
+                self.reduce_op, self.unit_values)
 
 
 class DeviceResult(NamedTuple):
@@ -135,6 +137,14 @@ class DeviceEngine:
                 live = idx < n_real
                 valid = valid & live
                 map_oflow = jnp.where(live, map_oflow, 0)
+                # a VALID record whose key is literally the sentinel pair
+                # is remapped to (0,0) — matching sorted_unique_reduce's
+                # remap — so buf_valid below cannot mistake it for padding
+                # (the map_fn contract promises drops are always counted,
+                # never silent)
+                is_sent = ((keys[:, 0] == SENTINEL)
+                           & (keys[:, 1] == SENTINEL))
+                keys = jnp.where(is_sent[:, None], jnp.uint32(0), keys)
                 # invalid rows -> sentinel keys (sort to the end)
                 kk = jnp.where(valid[:, None], keys, SENTINEL)
                 buf_k = jax.lax.dynamic_update_slice(buf_k, kk, (j * T, 0))

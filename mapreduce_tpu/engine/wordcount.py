@@ -21,7 +21,8 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..ops.compaction import tile_compact
-from ..ops.tokenize import tokenize_hash, shard_text
+from ..ops.tokenize import (
+    HASH_A1, HASH_A2, HASH_A3, tokenize_hash, shard_text)
 from .device_engine import DeviceEngine, EngineConfig
 
 #: whitespace byte values (must match ops/tokenize._WS)
@@ -54,34 +55,83 @@ def _wordcount_map_fn(chunk, chunk_index, cfg: EngineConfig):
     return keys, values, payload, tc.valid, tc.overflow
 
 
+def _verify_reduce_op(a, b):
+    """Associative+commutative: lane 0 count sum, lanes 1/2 min/max of the
+    third (independent) word hash.  After full reduction, lane1 != lane2
+    for a unique key proves two DISTINCT byte strings shared both key
+    lanes (a 64-bit collision) — detection the host alone cannot do,
+    since the device-side merge leaves it only one representative."""
+    import jax.numpy as jnp
+
+    return jnp.stack([a[..., 0] + b[..., 0],
+                      jnp.minimum(a[..., 1], b[..., 1]),
+                      jnp.maximum(a[..., 2], b[..., 2])], axis=-1)
+
+
+def _wordcount_map_fn_verify(chunk, chunk_index, cfg: EngineConfig):
+    """Collision-verify variant: values = [count=1, h3, h3] where h3 is a
+    third polynomial hash lane, reduced with (sum, min, max)."""
+    import jax.numpy as jnp
+
+    L = chunk.shape[0]
+    toks = tokenize_hash(chunk, multipliers=(HASH_A1, HASH_A2, HASH_A3))
+    gstart = chunk_index * L + toks.start
+    tc = tile_compact(toks.is_end, cfg.tile, cfg.tile_records,
+                      toks.keys[:, 0], toks.keys[:, 1],
+                      toks.keys[:, 2], gstart)
+    k1, k2, k3, gs = tc.arrays
+    keys = jnp.stack([k1, k2], axis=-1)
+    h3 = k3.astype(jnp.int32)
+    values = jnp.stack([tc.valid.astype(jnp.int32), h3, h3], axis=-1)
+    payload = gs.astype(jnp.int32)[:, None]
+    return keys, values, payload, tc.valid, tc.overflow
+
+
 class DeviceWordCount:
     """Count words of a text corpus on a TPU mesh.
 
     ``chunk_len`` is the static per-chunk byte length; capacities default
     to values sized for natural-language vocabularies and are doubled
     automatically on overflow (DeviceEngine.run).
+
+    ``verify_collisions=True`` detects 64-bit hash-key collisions (two
+    distinct words merged on device; odds ~3e-8 at a 1M vocabulary) by
+    carrying a third independent hash lane reduced with (min, max) — at
+    the cost of three extra sort operands per stage.
     """
 
     def __init__(self, mesh: Mesh, chunk_len: int = 1 << 22,
-                 config: Optional[EngineConfig] = None) -> None:
+                 config: Optional[EngineConfig] = None,
+                 verify_collisions: bool = False) -> None:
         self.mesh = mesh
         self.chunk_len = chunk_len
+        self.verify_collisions = verify_collisions
         cfg = config or EngineConfig(
             local_capacity=1 << 17, exchange_capacity=1 << 15,
             out_capacity=1 << 17)
-        # wordcount records are unit counts: run lengths replace a value
-        # lane (drops one sort operand)
         from dataclasses import replace
-        cfg = replace(cfg, unit_values=True, reduce_op="sum",
-                      tile=min(cfg.tile, chunk_len))
+        if verify_collisions:
+            # carry [count, h3, h3] value lanes reduced with
+            # (sum, min, max): min != max after full reduction proves a
+            # 64-bit key collision (checked in materialize_counts)
+            cfg = replace(cfg, unit_values=False,
+                          reduce_op=_verify_reduce_op,
+                          tile=min(cfg.tile, chunk_len))
+        else:
+            # wordcount records are unit counts: run lengths replace a
+            # value lane (drops one sort operand)
+            cfg = replace(cfg, unit_values=True, reduce_op="sum",
+                          tile=min(cfg.tile, chunk_len))
         self.config = cfg
+        self._map_fn = (_wordcount_map_fn_verify if verify_collisions
+                        else _wordcount_map_fn)
         self._engines: Dict[int, DeviceEngine] = {}
 
     def _engine_for(self, padded_len: int) -> DeviceEngine:
         """One engine per padded chunk length."""
         if padded_len not in self._engines:
             self._engines[padded_len] = DeviceEngine(
-                self.mesh, _wordcount_map_fn, self.config)
+                self.mesh, self._map_fn, self.config)
         return self._engines[padded_len]
 
     @property
@@ -143,12 +193,32 @@ def materialize_counts(chunks: np.ndarray, result) -> Dict[bytes, int]:
     S, L = chunks.shape
     valid = result.valid.reshape(-1)
     starts = result.payload.reshape(-1, result.payload.shape[-1])[:, 0]
-    vals = result.values.reshape(-1)
+    # verify mode carries [count, min(h3), max(h3)] value lanes
+    verify = result.values.ndim == 3
+    if verify:
+        vals3 = result.values.reshape(-1, 3)
+        vals = vals3[:, 0]
+    else:
+        vals = result.values.reshape(-1)
     live_rows = np.nonzero(valid)[0]
     if live_rows.size == 0:
         return {}
     gstart = starts[live_rows].astype(np.int64)
     counts = vals[live_rows]
+    if verify:
+        # two DISTINCT words sharing both 32-bit key lanes would have
+        # been merged on device; their third-lane hashes differ (w.p.
+        # 1 - 2^-32), so min(h3) != max(h3) exposes the merge.  The host
+        # cannot see this any other way — the merged unique keeps only
+        # one representative occurrence.
+        mins = vals3[live_rows, 1]
+        maxs = vals3[live_rows, 2]
+        bad = np.nonzero(mins != maxs)[0]
+        if bad.size:
+            raise RuntimeError(
+                f"64-bit hash collision detected for {bad.size} key(s): "
+                "distinct words were merged on device. Re-run with "
+                "different HASH_A1/HASH_A2 multipliers (ops/tokenize.py).")
 
     flat = chunks.reshape(-1)
     # windows[i] = corpus bytes [gstart_i, gstart_i + _WINDOW)

@@ -9,5 +9,8 @@ All shapes are static and padding is explicit (valid masks), keeping
 everything jit/shard_map-compatible (SURVEY.md §7 hard part (a)).
 """
 
-from .segmented import combine_by_key, compact, sort_by_key  # noqa: F401
+from .compaction import tile_compact  # noqa: F401
+from .segscan import (  # noqa: F401
+    SENTINEL, ladder_cummax, ladder_cumsum, segmented_scan,
+    sorted_unique_reduce)
 from .tokenize import tokenize_hash, WORD_HASH_LANES  # noqa: F401

@@ -3,7 +3,7 @@
 Dense record extraction from per-position masks is the first step of the
 device map phase (the role job.lua:77-97's per-token ``table.insert``
 plays on the host).  The obvious XLA formulation — cumsum + scatter rows
-to their rank (segmented.compact) — is wrong for TPU at scale: scatter
+to their rank (round 1's design) — is wrong for TPU at scale: scatter
 throughput measured on v5e is ~100M elements/s, so compacting each 4MB
 chunk's per-byte arrays costs ~150ms, dwarfing every other stage.
 

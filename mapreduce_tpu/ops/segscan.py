@@ -27,8 +27,9 @@ import jax
 import jax.numpy as jnp
 
 #: sentinel key lane value marking invalid rows (sorts to the end);
-#: real keys equal to the sentinel pair are remapped (hashtable.py does
-#: the same) so (SENTINEL, SENTINEL) is unambiguous.
+#: real keys equal to the sentinel pair are remapped to (0, 0) — here and
+#: at record-buffer build time (device_engine step) — so
+#: (SENTINEL, SENTINEL) is unambiguous.
 SENTINEL = jnp.uint32(0xFFFFFFFF)
 
 

@@ -46,6 +46,8 @@ import numpy as np
 #: FNV prime and a Murmur3 finalizer constant)
 HASH_A1 = 16777619
 HASH_A2 = 0x85EBCA6B
+#: third, independent lane used only by collision-verify mode
+HASH_A3 = 0xCC9E2D51
 WORD_HASH_LANES = 2
 
 _WS = (32, 9, 10, 13, 12, 11)
@@ -161,8 +163,12 @@ def _cummax_scan(x: jax.Array) -> jax.Array:
     return jnp.maximum(inner, prefix[:, None]).reshape(L)
 
 
-def tokenize_hash(chunk: jax.Array) -> TokenStream:
-    """Tokenize one padded byte chunk ``[L] uint8`` entirely on-device."""
+def tokenize_hash(chunk: jax.Array,
+                  multipliers=(HASH_A1, HASH_A2)) -> TokenStream:
+    """Tokenize one padded byte chunk ``[L] uint8`` entirely on-device.
+
+    *multipliers* selects the polynomial hash lanes (one affine scan
+    each); collision-verify mode passes a third lane."""
     L = chunk.shape[0]
     b32 = chunk.astype(jnp.uint32)
     space = _is_space(chunk)
@@ -175,9 +181,9 @@ def tokenize_hash(chunk: jax.Array) -> TokenStream:
     prev_space = jnp.concatenate([jnp.ones((1,), bool), space[:-1]])
     is_start = word & prev_space
 
-    # two independent polynomial hash lanes via one affine scan each
+    # independent polynomial hash lanes via one affine scan each
     keys = []
-    for a in (HASH_A1, HASH_A2):
+    for a in multipliers:
         m = jnp.where(word, jnp.uint32(a), jnp.uint32(0))
         c = jnp.where(word, b32 + jnp.uint32(1), jnp.uint32(0))
         keys.append(_affine_scan(m, c))

@@ -10,7 +10,6 @@ import jax
 import jax.numpy as jnp
 
 from mapreduce_tpu.engine import DeviceEngine, DeviceWordCount, EngineConfig
-from mapreduce_tpu.ops.segmented import combine_by_key, compact, sort_by_key
 from mapreduce_tpu.ops.tokenize import (
     shard_text, tokenize_hash, word_hashes_host)
 from mapreduce_tpu.parallel import make_mesh, partition_exchange
@@ -41,54 +40,6 @@ def test_tokenize_empty_and_all_spaces():
     chunk = jnp.asarray(np.full(128, ord(" "), dtype=np.uint8))
     toks = tokenize_hash(chunk)
     assert not bool(np.asarray(toks.is_end).any())
-
-
-def test_compact():
-    mask = jnp.asarray([0, 1, 0, 1, 1, 0], dtype=bool)
-    vals = jnp.arange(6, dtype=jnp.int32)
-    (packed,), valid, n = compact(mask, 4, vals)
-    assert int(n) == 3
-    assert list(np.asarray(packed[:3])) == [1, 3, 4]
-    assert list(np.asarray(valid)) == [True, True, True, False]
-    # overflow: capacity smaller than live rows
-    (_packed,), valid2, n2 = compact(mask, 2, vals)
-    assert int(n2) == 3 and int(valid2.sum()) == 2
-
-
-def test_combine_by_key_sums_and_dedups():
-    keys = jnp.asarray([[1, 1], [2, 2], [1, 1], [3, 3], [2, 2], [9, 9]],
-                       dtype=jnp.uint32)
-    vals = jnp.asarray([10, 20, 30, 40, 50, 99], dtype=jnp.int32)
-    pay = jnp.arange(6, dtype=jnp.int32)[:, None]
-    valid = jnp.asarray([1, 1, 1, 1, 1, 0], dtype=bool)  # row 5 is padding
-    out = combine_by_key(keys, vals, pay, valid, capacity=4, op="sum")
-    assert int(out.n_unique) == 3
-    live = {tuple(map(int, out.keys[i])): int(out.values[i])
-            for i in range(4) if bool(out.valid[i])}
-    assert live == {(1, 1): 40, (2, 2): 70, (3, 3): 40}
-    # keys ascend among valid rows
-    ks = [tuple(map(int, out.keys[i])) for i in range(3)]
-    assert ks == sorted(ks)
-
-
-def test_combine_by_key_min_max_and_overflow():
-    keys = jnp.asarray([[5, 0], [5, 0], [7, 0]], dtype=jnp.uint32)
-    vals = jnp.asarray([3, 9, 4], dtype=jnp.int32)
-    pay = jnp.zeros((3, 1), jnp.int32)
-    valid = jnp.ones((3,), bool)
-    mx = combine_by_key(keys, vals, pay, valid, capacity=2, op="max")
-    assert int(mx.values[0]) == 9 and int(mx.values[1]) == 4
-    # capacity 1 < 2 unique -> overflow signalled via n_unique
-    sm = combine_by_key(keys, vals, pay, valid, capacity=1, op="sum")
-    assert int(sm.n_unique) == 2
-
-
-def test_combine_all_invalid():
-    keys = jnp.zeros((4, 2), jnp.uint32)
-    out = combine_by_key(keys, jnp.zeros((4,), jnp.int32),
-                         jnp.zeros((4, 1), jnp.int32),
-                         jnp.zeros((4,), bool), capacity=4)
-    assert int(out.n_unique) == 0 and not bool(out.valid.any())
 
 
 def test_partition_exchange_routes_all_records():
@@ -145,6 +96,40 @@ def test_partition_exchange_overflow_counted():
         out_specs=(PS("data"),) * 5)
     *_rest, oflow = fn(keys, vals, pay, valid)
     assert int(np.asarray(oflow).sum()) == P_ * (n - cap)
+
+
+def test_engine_valid_sentinel_pair_key_not_dropped():
+    """A VALID record whose key is literally (SENTINEL, SENTINEL) must be
+    remapped (to (0,0)), not silently dropped — the map contract promises
+    every drop is counted (round-2 ADVICE: step() encoded invalidity as
+    the sentinel pair and lost such records)."""
+    from mapreduce_tpu.ops.segscan import SENTINEL
+    S = int(SENTINEL)
+
+    def map_fn(chunk, chunk_index, cfg):
+        # 4 records per chunk: two sentinel-pair keys, one normal, one
+        # invalid row
+        keys = jnp.asarray([[S, S], [S, S], [7, 7], [1, 1]], jnp.uint32)
+        vals = jnp.asarray([10, 20, 5, 99], jnp.int32)
+        pay = jnp.arange(4, dtype=jnp.int32)[:, None]
+        valid = jnp.asarray([True, True, True, False])
+        return keys, vals, pay, valid, jnp.int32(0)
+
+    mesh = make_mesh()
+    eng = DeviceEngine(mesh, map_fn,
+                       EngineConfig(local_capacity=16, exchange_capacity=8,
+                                    out_capacity=16))
+    chunks = np.zeros((8, 4), dtype=np.uint8)
+    res = eng.run(chunks)
+    assert res.overflow == 0
+    got = {}
+    for p in range(res.keys.shape[0]):
+        for i in range(res.keys.shape[1]):
+            if res.valid[p, i]:
+                k = (int(res.keys[p, i, 0]), int(res.keys[p, i, 1]))
+                got[k] = got.get(k, 0) + int(res.values[p, i])
+    # 8 chunks x (10+20) per chunk under key (0,0); 8 x 5 under (7,7)
+    assert got == {(0, 0): 240, (7, 7): 40}
 
 
 @pytest.fixture(scope="module")
@@ -214,6 +199,40 @@ def test_device_wordcount_wave_pipeline_overflow_retry(wc_mesh):
                             out_capacity=32))
     got = wc.count_bytes(data, waves=2)
     assert got == _oracle(data)
+
+
+def test_device_wordcount_verify_mode_matches_oracle(wc_mesh):
+    """verify_collisions=True carries a third hash lane reduced with
+    (min, max); on collision-free text the counts are identical to the
+    fast path and the check passes silently."""
+    data = _random_text(n_words=4000, seed=6)
+    wc = DeviceWordCount(wc_mesh, chunk_len=2048, verify_collisions=True)
+    got = wc.count_bytes(data, waves=2)
+    assert got == _oracle(data)
+
+
+def test_materialize_detects_forced_collision():
+    """A unique whose min(h3) != max(h3) proves two distinct words were
+    merged on device; materialize_counts must raise, not return a merged
+    count (a host-only check cannot see this — the device merge leaves
+    one representative)."""
+    from mapreduce_tpu.engine.wordcount import materialize_counts
+
+    chunks = np.frombuffer(b"aa bb " + b" " * 58, dtype=np.uint8)
+    chunks = chunks.reshape(1, 64).copy()
+
+    class R:
+        keys = np.array([[[7, 7]]], dtype=np.uint32)
+        values = np.array([[[5, 100, 200]]], dtype=np.int32)  # min != max
+        payload = np.array([[[0]]], dtype=np.int32)
+        valid = np.array([[True]])
+        overflow = 0
+
+    with pytest.raises(RuntimeError, match="collision"):
+        materialize_counts(chunks, R())
+    # and the clean case passes
+    R.values = np.array([[[5, 100, 100]]], dtype=np.int32)
+    assert materialize_counts(chunks, R()) == {b"aa": 5}
 
 
 def test_device_wordcount_mixed_mesh():
