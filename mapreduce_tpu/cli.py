@@ -132,35 +132,34 @@ def cmd_wordcount(argv: List[str]) -> int:
     args = p.parse_args(argv)
     _setup_logging(args.verbose)
 
+    import uuid
+
+    from .server import Server
+
+    connstr = f"mem://{uuid.uuid4().hex}"
+    m = "mapreduce_tpu.examples.wordcount"
+    params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
+                             "reducefn", "finalfn")}
+    params["combinerfn"] = m
+    params["storage"] = f"mem:{uuid.uuid4().hex}"
+    params["init_args"] = {"files": args.files,
+                           "num_reducers": args.num_reducers}
+    threads = []
     if args.device:
-        from .engine import DeviceWordCount
-        from .parallel import make_mesh
-
-        wc = DeviceWordCount(make_mesh())
-        counts = {k.decode("utf-8", "replace"): v
-                  for k, v in wc.count_files(args.files).items()}
+        # the unified fast path: the same server machinery dispatches the
+        # fused map+shuffle+reduce to the SPMD engine — no workers needed
+        params["device"] = True
     else:
-        import uuid
-
-        from .server import Server
         from .worker import spawn_worker_threads
 
-        connstr = f"mem://{uuid.uuid4().hex}"
-        m = "mapreduce_tpu.examples.wordcount"
-        params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
-                                 "reducefn", "finalfn")}
-        params["combinerfn"] = m
-        params["storage"] = f"mem:{uuid.uuid4().hex}"
-        params["init_args"] = {"files": args.files,
-                               "num_reducers": args.num_reducers}
         threads = spawn_worker_threads(connstr, "wc", args.workers)
-        server = Server(connstr, "wc")
-        server.configure(params)
-        server.loop()
-        for t in threads:
-            t.join(timeout=30)
-        from .examples.wordcount import RESULT
-        counts = dict(RESULT)
+    server = Server(connstr, "wc")
+    server.configure(params)
+    server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    from .examples.wordcount import RESULT
+    counts = dict(RESULT)
     for word in sorted(counts, key=lambda w: (-counts[w], w)):
         print(counts[word], word)
     return 0

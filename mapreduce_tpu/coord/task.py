@@ -101,6 +101,7 @@ class Task:
             "storage": params["storage"],
             "path": params["path"],
             "result_ns": params.get("result_ns", self._cnn.ns("result")),
+            "device": bool(params.get("device", False)),
         }
         store = self._cnn.connect()
         store.update(self.task_ns(), {"_id": self.SINGLETON_ID}, doc,

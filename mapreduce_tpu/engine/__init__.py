@@ -10,4 +10,5 @@ until the final (small) aggregated result.
 """
 
 from .device_engine import DeviceEngine, EngineConfig, DeviceResult  # noqa: F401
-from .wordcount import DeviceWordCount  # noqa: F401
+from .wordcount import (  # noqa: F401
+    DeviceWordCount, materialize_counts, wordcount_map_fn)

@@ -55,6 +55,11 @@ def _wordcount_map_fn(chunk, chunk_index, cfg: EngineConfig):
     return keys, values, payload, tc.valid, tc.overflow
 
 
+#: public name for modules wiring the engine through the unified device
+#: fast path (spec.DeviceSpec.map_fn)
+wordcount_map_fn = _wordcount_map_fn
+
+
 def _verify_reduce_op(a, b):
     """Associative+commutative: lane 0 count sum, lanes 1/2 min/max of the
     third (independent) word hash.  After full reduction, lane1 != lane2

@@ -60,3 +60,50 @@ def finalfn(pairs) -> bool:
     for key, values in pairs:
         RESULT[key] = values[0]
     return True
+
+
+# -- device fast path hooks (spec.DEVICE_HOOKS) ------------------------------
+# With ``device=True`` in Server.configure, the SAME module runs its fused
+# map+shuffle+reduce on the TPU mesh: taskfn still plans the file splits
+# above, finalfn still consumes the merged result pairs — only the middle
+# is replaced by one SPMD engine run.  Must produce results identical to
+# the host path (proved by tests/test_device_path.py against the naive
+# oracle).
+
+def device_config():
+    from ...engine import EngineConfig
+
+    return EngineConfig(local_capacity=1 << 16, exchange_capacity=1 << 14,
+                        out_capacity=1 << 16, tile=512, tile_records=128,
+                        reduce_op="sum", unit_values=True)
+
+
+def device_prepare(pairs, mesh):
+    """Read the taskfn-emitted files and shard their bytes over the mesh
+    (words never split across chunks)."""
+    from ...ops.tokenize import shard_text
+
+    ordered = sorted(pairs, key=lambda kv: str(kv[0]))
+    data = b"\n".join(open(path, "rb").read() for _, path in ordered)
+    chunk_len = int(_conf.get("device_chunk_len", 1 << 18))
+    n_dev = mesh.shape["data"]
+    n_chunks = max(1, -(-len(data) // chunk_len))
+    n_chunks = -(-n_chunks // n_dev) * n_dev
+    chunks, _L = shard_text(data, n_chunks, pad_multiple=512)
+    return chunks
+
+
+def device_map(chunk, chunk_index, cfg):
+    """Traceable map: tokenize+hash+compact one byte chunk (the engine
+    contract form of ``mapfn`` above)."""
+    from ...engine import wordcount_map_fn
+
+    return wordcount_map_fn(chunk, chunk_index, cfg)
+
+
+def device_result(chunks, result):
+    """Host materialisation: unique hashed words -> (word, [count])."""
+    from ...engine import materialize_counts
+
+    for word, count in materialize_counts(chunks, result).items():
+        yield word.decode("utf-8", "replace"), [count]

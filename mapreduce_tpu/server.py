@@ -20,13 +20,15 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from . import spec
 from . import storage as storage_mod
+from .coord import docstore
 from .coord.connection import Connection
 from .coord.job import map_results_prefix
 from .coord.task import Task, make_job
 from .utils.constants import (
     STATUS, TASK_STATUS, DEFAULT_SLEEP, MAX_JOB_RETRIES,
     MAX_TASKFN_VALUE_SIZE)
-from .utils.serialization import check_serializable
+from .utils.serialization import (
+    check_serializable, serialize_record, sort_key)
 from .utils.iterators import merge_iterator
 
 logger = logging.getLogger("mapreduce_tpu.server")
@@ -47,11 +49,19 @@ class Server:
         self.configured = False
         self.finished = False
         self.poll_sleep = DEFAULT_SLEEP
+        # device fast path state (configure(device=True)): the mesh and
+        # compiled engine live on the server instance — single-controller
+        # SPMD — and never enter the task document
+        self._mesh = None
+        self._device_engine = None
+        self._last_device_timings: Optional[Dict[str, Any]] = None
 
     # -- configuration (server.lua:417-460) --------------------------------
 
     def configure(self, params: Dict[str, Any]) -> None:
         params = dict(params)
+        # a live Mesh object is config for THIS process, not task state
+        self._mesh = params.pop("mesh", None)
         backend, path = storage_mod.get_storage_from(params.get("storage"))
         params["storage"] = f"{backend}:{path}"
         params["path"] = path
@@ -72,13 +82,12 @@ class Server:
         self.cnn.connect().remove(
             coll, {"status": {"$nin": TERMINAL}})
 
-    def _prepare_map(self) -> int:
+    def _collect_task_pairs(self) -> List[Tuple[Any, Any]]:
+        """Run taskfn and return its validated (key, value) emits
+        (dup-key check + value-size cap, server.lua:256-272)."""
         taskfn = spec.load_role(self.params["taskfn"], "taskfn")
-        coll = self.task.map_jobs_ns()
-        self._remove_pending_jobs(coll)
-        existing = {d["_id"] for d in self.cnn.connect().find(coll)}
         seen: Dict[str, Any] = {}
-        jobs: List[Dict[str, Any]] = []
+        pairs: List[Tuple[Any, Any]] = []
 
         def emit(key: Any, value: Any) -> None:
             check_serializable(key)
@@ -93,10 +102,17 @@ class Server:
                 raise ValueError(
                     f"taskfn value for key {key!r} exceeds "
                     f"{MAX_TASKFN_VALUE_SIZE} bytes (utils.lua:54)")
-            if kid not in existing:  # resume: don't recreate finished jobs
-                jobs.append(make_job(key, value))
+            pairs.append((key, value))
 
         taskfn.fn(emit)
+        return pairs
+
+    def _prepare_map(self) -> int:
+        coll = self.task.map_jobs_ns()
+        self._remove_pending_jobs(coll)
+        existing = {d["_id"] for d in self.cnn.connect().find(coll)}
+        jobs = [make_job(k, v) for k, v in self._collect_task_pairs()
+                if str(k) not in existing]  # resume: keep finished jobs
         self.task.insert_jobs(coll, jobs)
         self.task.set_task_status(TASK_STATUS.MAP)
         logger.info("map phase: %d jobs planned", len(jobs))
@@ -169,6 +185,89 @@ class Server:
         logger.info("reduce phase: %d partitions", len(jobs))
         return len(jobs)
 
+    # -- device fast path (the unified framework, SURVEY.md §7 steps 4-5) --
+
+    def _device_mesh(self):
+        if self._mesh is None:
+            from .parallel import make_mesh
+            self._mesh = make_mesh()
+        return self._mesh
+
+    def _get_device_engine(self, ds: spec.DeviceSpec, mesh):
+        if self._device_engine is None:
+            from .engine import DeviceEngine, EngineConfig
+            cfg = ds.config() if ds.config else EngineConfig()
+            self._device_engine = DeviceEngine(mesh, ds.map_fn, cfg)
+        return self._device_engine
+
+    def _run_device_phase(self) -> None:
+        """Fused map+shuffle+reduce on the TPU mesh: taskfn plans splits
+        host-side exactly as the general path does, the module's device
+        hooks turn them into one SPMD engine run, and the reduced uniques
+        land in the SAME result-file contract the host reduce writes — so
+        finalfn, stats, ``"loop"`` iteration and crash recovery are
+        shared, not duplicated.  One job document (``__device__``) records
+        the fused phase for the stats machinery; per-stage device timings
+        go into it and into ``task.stats.device``
+        (parity with the reference's per-phase report, server.lua:555-600).
+        """
+        coll = self.task.map_jobs_ns()
+        # device re-runs are idempotent whole-phase: forget prior jobs
+        self.cnn.connect().remove(coll, {})
+        pairs = self._collect_task_pairs()
+        job = make_job("__device__", {"pairs": len(pairs)})
+        now = docstore.now()
+        job.update({"worker": "server",
+                    "status": int(STATUS.RUNNING),
+                    "started_time": now,
+                    "lease_expires": now + self.task.job_lease})
+        self.task.insert_jobs(coll, [job])
+        self.task.set_task_status(TASK_STATUS.MAP)
+
+        ds = spec.load_device(self.params["mapfn"])
+        spec.load_role(self.params["mapfn"], "mapfn").ensure_init(
+            self.params.get("init_args"))
+        mesh = self._device_mesh()
+        t_cpu, t_real = time.process_time(), time.time()
+        chunks = ds.prepare(pairs, mesh)
+        engine = self._get_device_engine(ds, mesh)
+        timings: Dict[str, Any] = {}
+        res = engine.run(chunks, timings=timings)
+        if res.overflow:
+            raise RuntimeError(
+                f"device phase overflowed capacities by {res.overflow} "
+                "rows even after retries; raise the module's EngineConfig")
+        out_pairs = list(ds.result(chunks, res))
+
+        self.task.set_task_status(TASK_STATUS.REDUCE)
+        # one key-sorted result partition file in the shared record
+        # format: finalfn cannot tell which plane produced it.  Stale
+        # result partitions from a crashed (possibly host-plane) run are
+        # cleared first — _result_pairs merges every result.P* file, so a
+        # leftover P00001 would silently blend into the device output
+        storage = storage_mod.router(self.params["storage"])
+        result_ns = self.task.red_results_ns()
+        storage.remove_many(
+            storage.list("^" + re.escape(result_ns) + r"\.P\d+$"))
+        b = storage.builder()
+        for key, values in sorted(out_pairs,
+                                  key=lambda kv: sort_key(kv[0])):
+            check_serializable(key)
+            values = list(values)
+            check_serializable(values)
+            b.write_record_line(serialize_record(key, values))
+        b.build(f"{result_ns}.P00000")
+        self.cnn.connect().update(
+            coll, {"_id": "__device__"},
+            {"$set": {"status": int(STATUS.WRITTEN),
+                      "written_time": docstore.now(),
+                      "cpu_time": time.process_time() - t_cpu,
+                      "real_time": time.time() - t_real,
+                      "device_timings": timings}})
+        self._last_device_timings = timings
+        logger.info("device phase: %d splits -> %d uniques, timings %s",
+                    len(pairs), len(out_pairs), timings)
+
     # -- statistics (server.lua:155-183, 538-600) --------------------------
 
     def _phase_stats(self, coll: str) -> Dict[str, Any]:
@@ -194,6 +293,11 @@ class Server:
         stats = {"map": m, "reduce": r,
                  "cluster_time": m["cluster_time"] + r["cluster_time"],
                  "iteration": self.task.iteration()}
+        if self._last_device_timings is not None:
+            # per-stage device timings (upload/compute/readback/waves)
+            # into the persisted stats doc — the device-path form of the
+            # reference's per-phase report (server.lua:555-600)
+            stats["device"] = dict(self._last_device_timings)
         self.task.set_fields({"stats": stats})
         logger.info(
             "stats: map %d jobs (%d failed) cpu %.3fs cluster %.3fs | "
@@ -262,8 +366,14 @@ class Server:
                 # restore storage decisions from the surviving task doc
                 self.params["storage"] = self.task.tbl["storage"]
                 self.params["path"] = self.task.tbl["path"]
-                it = self.task.iteration()
-                skip_map = True
+                if self.params.get("device") or self.task.tbl.get("device"):
+                    # the device phase is fused: re-run it whole (its
+                    # map output never hits storage, so a REDUCE-state
+                    # resume has nothing to reduce from)
+                    it = max(self.task.iteration() - 1, 0)
+                else:
+                    it = self.task.iteration()
+                    skip_map = True
             elif st in (TASK_STATUS.WAIT, TASK_STATUS.MAP):
                 logger.warning("resuming crashed task at %s", st.value)
                 self.params["storage"] = self.task.tbl["storage"]
@@ -271,19 +381,32 @@ class Server:
                 it = max(self.task.iteration() - 1, 0)
 
         while not self.finished:
-            if not skip_map:
+            if self.params.get("device"):
+                # unified device fast path: ONE fused SPMD phase replaces
+                # map + shuffle + reduce; taskfn/finalfn/stats/loop stay
+                # exactly the host machinery
                 it += 1
-                self.task.create_collection(TASK_STATUS.WAIT, self.params, it)
+                self.task.create_collection(TASK_STATUS.WAIT, self.params,
+                                            it)
                 t0 = time.time()
-                self._prepare_map()
-                self._poll_phase(self.task.map_jobs_ns(), "map")
-                logger.info("map done in %.3fs", time.time() - t0)
+                self._run_device_phase()
+                logger.info("device map+reduce done in %.3fs",
+                            time.time() - t0)
             else:
-                skip_map = False
-            t0 = time.time()
-            self._prepare_reduce()
-            self._poll_phase(self.task.red_jobs_ns(), "reduce")
-            logger.info("reduce done in %.3fs", time.time() - t0)
+                if not skip_map:
+                    it += 1
+                    self.task.create_collection(TASK_STATUS.WAIT,
+                                                self.params, it)
+                    t0 = time.time()
+                    self._prepare_map()
+                    self._poll_phase(self.task.map_jobs_ns(), "map")
+                    logger.info("map done in %.3fs", time.time() - t0)
+                else:
+                    skip_map = False
+                t0 = time.time()
+                self._prepare_reduce()
+                self._poll_phase(self.task.red_jobs_ns(), "reduce")
+                logger.info("reduce done in %.3fs", time.time() - t0)
             stats = self._compute_stats()
             self._final()
         return stats

@@ -112,6 +112,51 @@ def is_aci(rm: RoleModule) -> bool:
     return all(rm.flags.get(f, False) for f in ACI_FLAGS)
 
 
+#: hooks a module exports to unlock the unified device fast path: with
+#: ``device=True`` in configure(), Server.loop dispatches the fused
+#: map+shuffle+reduce phases to the SPMD DeviceEngine while taskfn and
+#: finalfn stay host-side — ONE framework, two execution planes (the
+#: reference runs every workload through one server machinery,
+#: server.lua:464-609; this is its TPU form).
+DEVICE_HOOKS = ("device_prepare", "device_map", "device_result")
+
+
+@dataclass
+class DeviceSpec:
+    """The traceable analogue of the mapfn/reducefn module pair.
+
+    * ``prepare(pairs, mesh) -> np.ndarray chunks`` — host prep: turn the
+      taskfn-emitted (key, value) splits into the engine's chunk batch
+      (read files, shard bytes, pad) for the given mesh;
+    * ``map_fn(chunk, chunk_index, cfg)`` — traceable engine map_fn
+      (DeviceEngine contract: fixed-capacity hashed record batches);
+    * ``result(chunks, DeviceResult) -> iterable[(key, [values])]`` —
+      host materialisation of the reduced uniques into finalfn pairs;
+    * ``config() -> EngineConfig`` (optional) — capacities + reduce
+      monoid; defaults to EngineConfig().
+    """
+
+    name: str
+    prepare: Callable
+    map_fn: Callable
+    result: Callable
+    config: Optional[Callable] = None
+
+
+def load_device(module_name: str) -> Optional[DeviceSpec]:
+    """Resolve a module's device hooks; None when it exports none."""
+    mod = importlib.import_module(module_name)
+    if not all(callable(getattr(mod, h, None)) for h in DEVICE_HOOKS):
+        return None
+    return DeviceSpec(
+        name=module_name,
+        prepare=mod.device_prepare,
+        map_fn=mod.device_map,
+        result=mod.device_result,
+        config=getattr(mod, "device_config", None),
+    )
+
+
 def validate_spec(params: Dict[str, Any]) -> Dict[str, Any]:
     """Server-side validation of a configure() params table
     (server.lua:425-443): mandatory roles present and loadable."""
@@ -122,4 +167,15 @@ def validate_spec(params: Dict[str, Any]) -> Dict[str, Any]:
         load_role(name, role)
     if params.get("combinerfn"):
         load_role(params["combinerfn"], "combinerfn")
+    if params.get("device"):
+        if load_device(params["mapfn"]) is None:
+            raise ValueError(
+                f"device=True but module {params['mapfn']!r} does not "
+                f"export the device hooks {DEVICE_HOOKS}")
+        if not is_aci(load_role(params["reducefn"], "reducefn")):
+            raise ValueError(
+                "device=True requires an associative+commutative+"
+                "idempotent reducefn: the device engine reorders and "
+                "partially combines (the compiler-visible form of "
+                "reducefn.lua:10-14's flags)")
     return params

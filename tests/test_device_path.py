@@ -1,0 +1,196 @@
+"""The unified device fast path: one framework, two execution planes.
+
+VERDICT r2 item 3: a job-board task declaring device hooks must have its
+fused map+shuffle+reduce dispatched to the SPMD DeviceEngine by the SAME
+Server.loop that drives host workers — proved by running WordCount both
+ways against the naive oracle, with identical results, shared finalfn
+contract, stats parity, and ``"loop"`` iteration support.
+"""
+
+import uuid
+
+import pytest
+
+from mapreduce_tpu import spec
+from mapreduce_tpu.examples import naive
+from mapreduce_tpu.server import Server
+from mapreduce_tpu.utils.constants import STATUS
+from mapreduce_tpu.worker import spawn_worker_threads
+
+MODULE = "mapreduce_tpu.examples.wordcount"
+
+
+@pytest.fixture(autouse=True)
+def fresh_modules():
+    spec.clear_caches()
+    yield
+    spec.clear_caches()
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    texts = [
+        "the quick brown fox jumps over the lazy dog\n" * 8,
+        "pack my box with five dozen liquor jugs\nthe dog barks\n" * 5,
+        "lorem ipsum dolor sit amet the fox runs\n" * 6,
+    ]
+    files = []
+    for i, t in enumerate(texts):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(t)
+        files.append(str(p))
+    return files
+
+
+def _params(files, device=False):
+    params = {r: MODULE for r in ("taskfn", "mapfn", "partitionfn",
+                                  "reducefn", "finalfn")}
+    params["combinerfn"] = MODULE
+    params["storage"] = f"mem:{uuid.uuid4().hex}"
+    params["init_args"] = {"files": files, "num_reducers": 4,
+                           "device_chunk_len": 2048}
+    if device:
+        params["device"] = True
+    return params
+
+
+def _run(params, workers=0):
+    connstr = f"mem://{uuid.uuid4().hex}"
+    threads = (spawn_worker_threads(connstr, "wc", workers)
+               if workers else [])
+    server = Server(connstr, "wc")
+    server.configure(params)
+    stats = server.loop()
+    for t in threads:
+        t.join(timeout=60)
+    from mapreduce_tpu.examples.wordcount import RESULT
+    return server, stats, dict(RESULT)
+
+
+def test_device_path_equals_host_path_and_oracle(corpus):
+    oracle = naive.wordcount(corpus)
+
+    _, _, host_result = _run(_params(corpus), workers=2)
+    assert host_result == oracle
+
+    spec.clear_caches()
+    server, stats, device_result = _run(_params(corpus, device=True))
+    assert device_result == oracle
+    assert device_result == host_result
+
+    # stats parity: the fused phase is recorded as one WRITTEN map job
+    # with per-stage device timings, and the timings are persisted into
+    # the task stats doc (server.lua:555-600's report, device form)
+    assert stats["map"]["count"] == 1
+    assert stats["map"]["failed"] == 0
+    assert "device" in stats
+    for k in ("upload_s", "compute_s", "readback_s"):
+        assert k in stats["device"]
+    assert server.task.finished()
+
+
+def test_device_path_job_doc_records_timings(corpus):
+    server, _, _ = _run(_params(corpus, device=True))
+    docs = server.cnn.connect().find(server.task.map_jobs_ns())
+    assert len(docs) == 1
+    d = docs[0]
+    assert d["_id"] == "__device__"
+    assert d["status"] == int(STATUS.WRITTEN)
+    assert d["worker"] == "server"
+    assert "device_timings" in d and "compute_s" in d["device_timings"]
+
+
+def test_device_requires_aci_reducer(corpus):
+    params = _params(corpus, device=True)
+    # reducefn2 is the general (non-ACI) reducer form
+    params["reducefn"] = "mapreduce_tpu.examples.wordcount_split.reducefn2"
+    server = Server(f"mem://{uuid.uuid4().hex}", "wc")
+    with pytest.raises(ValueError, match="associative"):
+        server.configure(params)
+
+
+def test_device_requires_hooks(corpus):
+    params = _params(corpus, device=True)
+    # wordcount_split.mapfn has no device hooks
+    params["mapfn"] = "mapreduce_tpu.examples.wordcount_split.mapfn"
+    server = Server(f"mem://{uuid.uuid4().hex}", "wc")
+    with pytest.raises(ValueError, match="device hooks"):
+        server.configure(params)
+
+
+def test_device_crash_resume_at_reduce(corpus):
+    """A server that died between the engine run and the result write
+    left the task doc at REDUCE.  The host path would resume straight
+    into reduce, but the fused device phase has no map files in storage —
+    recovery must re-run the whole device iteration, not final-ize
+    partial results."""
+    from mapreduce_tpu.utils.constants import TASK_STATUS
+
+    oracle = naive.wordcount(corpus)
+    connstr = f"mem://{uuid.uuid4().hex}"
+    params = _params(corpus, device=True)
+
+    # simulate the crashed run: task doc exists, status REDUCE, no result
+    # files written
+    dead = Server(connstr, "wc")
+    dead.configure(params)
+    dead.task.create_collection(TASK_STATUS.REDUCE, dead.params, 1)
+
+    spec.clear_caches()
+    server = Server(connstr, "wc")
+    server.configure(params)
+    stats = server.loop()
+    from mapreduce_tpu.examples.wordcount import RESULT
+    assert dict(RESULT) == oracle
+    assert stats["iteration"] == 1
+    assert server.task.finished()
+
+
+def test_device_phase_clears_stale_result_partitions(corpus):
+    """A crashed host-plane run can leave WRITTEN result partitions; a
+    device-plane resume must clear them, or _result_pairs would merge
+    stale values into the device output (finalfn sees result.P* files
+    from BOTH planes)."""
+    from mapreduce_tpu import storage as storage_mod
+    from mapreduce_tpu.utils.serialization import serialize_record
+
+    oracle = naive.wordcount(corpus)
+    connstr = f"mem://{uuid.uuid4().hex}"
+    params = _params(corpus, device=True)
+
+    # plant a stale host-plane result partition in the same storage
+    st = storage_mod.router(params["storage"])
+    b = st.builder()
+    b.write_record_line(serialize_record("the", [99999]))
+    server0 = Server(connstr, "wc")
+    b.build(f"{server0.task.red_results_ns()}.P00001")
+
+    server = Server(connstr, "wc")
+    server.configure(params)
+    server.loop()
+    from mapreduce_tpu.examples.wordcount import RESULT
+    assert dict(RESULT) == oracle  # not blended with the stale 99999
+
+
+def test_device_path_iterative_loop(corpus, tmp_path):
+    """A device task returning "loop" re-runs the fused phase through the
+    same iteration machinery (server.lua:395-398)."""
+    import mapreduce_tpu.examples.wordcount as wc
+
+    oracle = naive.wordcount(corpus)
+    iterations = []
+    orig_finalfn = wc.finalfn
+
+    def looping_finalfn(pairs):
+        orig_finalfn(pairs)  # fills RESULT
+        iterations.append(dict(wc.RESULT))
+        return "loop" if len(iterations) < 3 else True
+
+    wc_finalfn, wc.finalfn = wc.finalfn, looping_finalfn
+    try:
+        _, stats, result = _run(_params(corpus, device=True))
+    finally:
+        wc.finalfn = wc_finalfn
+    assert len(iterations) == 3
+    assert all(it == oracle for it in iterations)
+    assert stats["iteration"] == 3
