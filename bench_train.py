@@ -1,0 +1,173 @@
+"""Training-path benchmark: steps/sec/chip and MFU on real hardware.
+
+BASELINE.md requires measured training throughput ("steps/sec/chip",
+"MFU") — the quantitative form of the rebuild's north star that no CPU
+worker sits in the training loop (the reference moves the whole serialized
+model through GridFS every minibatch, SURVEY.md §3.5, and publishes no
+training numbers at all, init.lua:19-20).
+
+Prints one JSON line per model family:
+
+  {"metric": "mlp_train_steps_per_s", "value": ..., "unit": "steps/s", ...}
+  {"metric": "transformer_train_tokens_per_s", "value": ..., "unit":
+   "tok/s", "mfu": ...}
+
+MFU = achieved training FLOP/s over the chip's peak bf16 FLOP/s (v5e:
+197 TFLOP/s).  The MLP is the reference-parity model (256-128-10,
+APRIL-ANN init.lua:12) — tiny by design, so its MFU is reported but
+meaningless; the transformer is the beyond-parity long-context family and
+is the real MXU utilisation story.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+#: peak dense bf16 FLOP/s per chip by TPU generation (v5e default)
+PEAK_FLOPS = {"tpu": 197e12, "cpu": None}
+
+STEPS = 20
+WARMUP = 3
+
+
+def _timeit(step_fn, n=None):
+    n = STEPS if n is None else n
+    # force completion with a VALUE readback: on the tunnelled platform,
+    # block_until_ready on a small scalar can return before execution
+    # finishes (measured: 0.2ms/step "blocked" vs 250ms/step real), while
+    # np.asarray must wait for the data.  The final loss depends on every
+    # prior step's params, so one readback drains the whole chain.
+    for _ in range(WARMUP):
+        out = step_fn()
+    np.asarray(out)
+    t0 = time.time()
+    for _ in range(n):
+        out = step_fn()
+    np.asarray(out)
+    return (time.time() - t0) / n
+
+
+def bench_mlp(mesh, platform):
+    import jax
+    from mapreduce_tpu.models import (
+        DistributedTrainer, MLPConfig, TrainConfig)
+
+    mlp_cfg = MLPConfig(sizes=(256, 128, 10))  # reference init.lua:12
+    cfg = TrainConfig(bunch_size=128)
+    tr = DistributedTrainer(mesh, mlp_cfg, cfg)
+    params, opt_state = tr.init_state()
+    n_data = mesh.shape["data"]
+    batch = cfg.bunch_size * n_data
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 256)).astype(np.float32)
+    y = (np.arange(batch) % 10).astype(np.int32)
+    xd, yd = tr.place_batch(x, y)
+
+    state = {"params": params, "opt": opt_state}
+
+    def step():
+        state["params"], state["opt"], loss = tr._train_step(
+            state["params"], state["opt"], xd, yd)
+        return loss
+
+    sec = _timeit(step)
+    # training FLOPs ~= 6 * params * batch (2 fwd + 4 bwd per weight)
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree.leaves(state["params"]))
+    flops = 6.0 * n_params * batch
+    n_chips = len(mesh.devices.flat)
+    peak = PEAK_FLOPS.get(platform)
+    out = {
+        "metric": "mlp_train_steps_per_s",
+        "value": round(1.0 / sec, 2),
+        "unit": "steps/s",
+        "per_chip_steps_per_s": round(1.0 / sec / n_chips, 2),
+        "global_batch": batch,
+        "flops_per_step": flops,
+    }
+    if peak:
+        out["mfu"] = round(flops / sec / (peak * n_chips), 6)
+    return out
+
+
+def bench_transformer(mesh, platform):
+    import jax
+    from mapreduce_tpu.models.transformer import (
+        TransformerConfig, TransformerTrainer)
+
+    n_model = mesh.shape["model"]
+    n_data = mesh.shape["data"]
+    cfg = TransformerConfig(
+        vocab=32768, embed=1024, n_layers=8,
+        n_heads=16, head_dim=64, ffn=4096)
+    B = 4
+    T = 2048 * n_data  # sequence-parallel: T/n_data per device
+    tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+    params = tr.init_params()
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab, size=(B, T + 1)).astype(np.int32)
+    x, y = tr.place_batch(toks)
+
+    state = {"params": params}
+
+    def step():
+        state["params"], loss = tr._train_step(state["params"], x, y)
+        return loss
+
+    sec = _timeit(step)
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree.leaves(state["params"]))
+    tokens = B * T
+    # 6ND for the dense matmuls + attention: fwd QK^T and AV are
+    # 2*B*H*T^2*D FLOPs each; x3 for training
+    H, D = cfg.n_heads, cfg.head_dim
+    attn_flops = 3 * 2 * 2 * B * H * T * T * D
+    flops = 6.0 * n_params * tokens + attn_flops
+    n_chips = len(mesh.devices.flat)
+    peak = PEAK_FLOPS.get(platform)
+    out = {
+        "metric": "transformer_train_tokens_per_s",
+        "value": round(tokens / sec, 1),
+        "unit": "tok/s",
+        "steps_per_s": round(1.0 / sec, 3),
+        "seq_len": T,
+        "global_batch": B,
+        "params_m": round(n_params / 1e6, 1),
+        "flops_per_step": flops,
+    }
+    if peak:
+        out["mfu"] = round(flops / sec / (peak * n_chips), 4)
+    return out
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(
+                          os.path.abspath(__file__)), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from mapreduce_tpu.parallel import make_mesh
+
+    platform = jax.devices()[0].platform
+    mesh = make_mesh()
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        global STEPS
+        STEPS = 3
+
+    print(f"# platform={platform} devices={len(mesh.devices.flat)}; "
+          "mlp ...", file=sys.stderr, flush=True)
+    print(json.dumps(bench_mlp(mesh, platform)), flush=True)
+    print("# transformer ...", file=sys.stderr, flush=True)
+    print(json.dumps(bench_transformer(mesh, platform)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,11 +71,17 @@ def finalfn(pairs) -> bool:
 # oracle).
 
 def device_config():
+    """Capacities default to DeviceWordCount's natural-language sizing
+    (vocabulary up to ~1M uniques with the 3-retry doubling headroom) and
+    are overridable through init_args for small test corpora."""
     from ...engine import EngineConfig
 
-    return EngineConfig(local_capacity=1 << 16, exchange_capacity=1 << 14,
-                        out_capacity=1 << 16, tile=512, tile_records=128,
-                        reduce_op="sum", unit_values=True)
+    return EngineConfig(
+        local_capacity=int(_conf.get("device_local_capacity", 1 << 17)),
+        exchange_capacity=int(_conf.get("device_exchange_capacity",
+                                        1 << 15)),
+        out_capacity=int(_conf.get("device_out_capacity", 1 << 17)),
+        tile=512, tile_records=128, reduce_op="sum", unit_values=True)
 
 
 def device_prepare(pairs, mesh):
@@ -85,7 +91,7 @@ def device_prepare(pairs, mesh):
 
     ordered = sorted(pairs, key=lambda kv: str(kv[0]))
     data = b"\n".join(open(path, "rb").read() for _, path in ordered)
-    chunk_len = int(_conf.get("device_chunk_len", 1 << 18))
+    chunk_len = int(_conf.get("device_chunk_len", 1 << 22))
     n_dev = mesh.shape["data"]
     n_chunks = max(1, -(-len(data) // chunk_len))
     n_chunks = -(-n_chunks // n_dev) * n_dev

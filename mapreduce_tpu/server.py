@@ -246,9 +246,7 @@ class Server:
         # cleared first — _result_pairs merges every result.P* file, so a
         # leftover P00001 would silently blend into the device output
         storage = storage_mod.router(self.params["storage"])
-        result_ns = self.task.red_results_ns()
-        storage.remove_many(
-            storage.list("^" + re.escape(result_ns) + r"\.P\d+$"))
+        storage.remove_many(self._result_partitions(storage))
         b = storage.builder()
         for key, values in sorted(out_pairs,
                                   key=lambda kv: sort_key(kv[0])):
@@ -256,7 +254,7 @@ class Server:
             values = list(values)
             check_serializable(values)
             b.write_record_line(serialize_record(key, values))
-        b.build(f"{result_ns}.P00000")
+        b.build(f"{self.task.red_results_ns()}.P00000")
         self.cnn.connect().update(
             coll, {"_id": "__device__"},
             {"$set": {"status": int(STATUS.WRITTEN),
@@ -308,12 +306,19 @@ class Server:
 
     # -- final (server.lua:346-411) ----------------------------------------
 
+
+    def _result_partitions(self, storage) -> List[str]:
+        """Every result partition file for this task — the single source
+        of truth for the result-file naming pattern (written by host
+        reduce jobs and the device phase alike)."""
+        result_ns = self.task.red_results_ns()
+        return storage.list("^" + re.escape(result_ns) + r"\.P\d+$")
+
     def _result_pairs(self, storage) -> Iterator[Tuple[Any, List[Any]]]:
         """Merged iterator over all result partition files, globally key-
         sorted (server.lua:352-383 iterates files in sorted order; we merge
         so finalfn sees one ordered stream)."""
-        result_ns = self.task.red_results_ns()
-        names = storage.list("^" + re.escape(result_ns) + r"\.P\d+$")
+        names = self._result_partitions(storage)
 
         def records(name):
             from .utils.serialization import parse_record
@@ -345,8 +350,7 @@ class Server:
         # result files are deleted unless the user asked to keep them by
         # returning False/None (server.lua:403-410)
         if reply in (True, "loop"):
-            storage.remove_many(
-                storage.list("^" + re.escape(result_ns) + r"\.P\d+$"))
+            storage.remove_many(self._result_partitions(storage))
         return reply
 
     # -- the driver loop (server.lua:464-609) ------------------------------

@@ -1,0 +1,32 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+
+mesh = make_mesh()
+cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                        n_heads=16, head_dim=64, ffn=4096)
+tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+params = tr.init_params()
+rng = np.random.default_rng(0)
+toks = rng.integers(0, cfg.vocab, size=(4, 2049)).astype(np.int32)
+x, y = tr.place_batch(toks)
+
+params, loss = tr._train_step(params, x, y)
+jax.block_until_ready(loss)
+print("loss after 1 step:", float(loss), flush=True)
+
+for i in range(3):
+    t0 = time.time()
+    params, loss = tr._train_step(params, x, y)
+    jax.block_until_ready(loss)
+    lv = float(loss)
+    print(f"step {i}: {time.time()-t0:.4f}s loss={lv:.4f}", flush=True)
+
+# also block on a param leaf, not just loss
+t0 = time.time()
+params, loss = tr._train_step(params, x, y)
+jax.block_until_ready(params["embed"])
+print(f"blocked on params: {time.time()-t0:.4f}s", flush=True)

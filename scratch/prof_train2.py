@@ -1,0 +1,54 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+
+mesh = make_mesh()
+cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                        n_heads=16, head_dim=64, ffn=4096)
+tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+params = tr.init_params()
+rng = np.random.default_rng(0)
+toks = rng.integers(0, cfg.vocab, size=(4, 2049)).astype(np.int32)
+x, y = tr.place_batch(toks)
+state = {"params": params}
+
+def step():
+    state["params"], loss = tr._train_step(state["params"], x, y)
+    return loss
+
+for _ in range(3):
+    out = step()
+jax.block_until_ready(out)
+print("warm done", flush=True)
+t0 = time.time()
+for i in range(10):
+    out = step()
+jax.block_until_ready(out)
+dt = (time.time() - t0) / 10
+print(f"chained loop: {dt*1000:.2f} ms/step", flush=True)
+
+# same but block every step
+t0 = time.time()
+for i in range(5):
+    out = step()
+    jax.block_until_ready(out)
+dt = (time.time() - t0) / 5
+print(f"blocked loop: {dt*1000:.2f} ms/step", flush=True)
+
+# does block_until_ready lie? readback the value
+t0 = time.time()
+for i in range(5):
+    out = step()
+    v = float(out)
+dt = (time.time() - t0) / 5
+print(f"float-readback loop: {dt*1000:.2f} ms/step, last loss {v:.4f}", flush=True)
+
+t0 = time.time()
+for i in range(5):
+    out = step()
+    jax.block_until_ready(state["params"]["embed"])
+dt = (time.time() - t0) / 5
+print(f"block-on-params loop: {dt*1000:.2f} ms/step", flush=True)
