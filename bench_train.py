@@ -100,7 +100,6 @@ def bench_transformer(mesh, platform):
     from mapreduce_tpu.models.transformer import (
         TransformerConfig, TransformerTrainer)
 
-    n_model = mesh.shape["model"]
     n_data = mesh.shape["data"]
     cfg = TransformerConfig(
         vocab=32768, embed=1024, n_layers=8,

@@ -245,6 +245,29 @@ class DeviceEngine:
         by_rows = max(1, chunks.shape[0] // self.n_dev)
         return min(self.MAX_WAVES, by_bytes, by_rows)
 
+    def _multiprocess(self) -> bool:
+        """True when the mesh spans devices of other JAX processes
+        (multi-controller SPMD under jax.distributed)."""
+        pid = jax.process_index()
+        return any(d.process_index != pid for d in self.mesh.devices.flat)
+
+    def _host(self, *arrays):
+        """Bring device arrays to host numpy.  On a single-process mesh
+        this is plain np.asarray; when the mesh spans processes, shards on
+        other hosts are not addressable, so the arrays are first
+        replicated (one all-gather) — every process then returns the
+        identical full value, keeping the engine's host surface (counts,
+        overflow checks) SPMD-consistent."""
+        if self._multiprocess():
+            key = ("host_gather", len(arrays))
+            if key not in self._compiled:
+                rep = NamedSharding(self.mesh, P())
+                self._compiled[key] = jax.jit(
+                    lambda *a: a, out_shardings=(rep,) * len(arrays))
+            arrays = self._compiled[key](*arrays)
+        out = [np.asarray(a) for a in arrays]
+        return out[0] if len(out) == 1 else out
+
     def _shard_inputs(self, chunks: np.ndarray, waves: int = 1):
         """Split the chunk batch into *waves* equal groups, each placed
         sharded over the data axis as one plain ``jax.device_put`` with a
@@ -365,7 +388,7 @@ class DeviceEngine:
             else:
                 compute_from = t0
             # the (tiny) overflow readbacks force program completion
-            total_oflow = sum(int(np.asarray(o).sum()) for o in oflows)
+            total_oflow = sum(int(self._host(o).sum()) for o in oflows)
             t_compute += time.time() - compute_from
             if total_oflow == 0:
                 break
@@ -374,11 +397,12 @@ class DeviceEngine:
         # sliced readback: only the live prefix of each partition's
         # capacity-padded result crosses the (slow) device->host link
         t0 = time.time()
-        n_live = np.asarray(valid.sum(axis=1))
+        n_live = self._host(valid.sum(axis=1))
         width = max(1, int(n_live.max()))
-        take = lambda a: np.asarray(a[:, :width])
-        result = DeviceResult(take(keys), take(vals), take(pay),
-                              take(valid), total_oflow)
+        keys_h, vals_h, pay_h, valid_h = self._host(
+            keys[:, :width], vals[:, :width], pay[:, :width],
+            valid[:, :width])
+        result = DeviceResult(keys_h, vals_h, pay_h, valid_h, total_oflow)
         t_readback = time.time() - t0
         if timings is not None:
             timings["waves"] = W

@@ -1,0 +1,68 @@
+"""Subprocess body for the jax.distributed multi-process test.
+
+Each OS process owns 4 virtual CPU devices; jax.distributed.initialize
+joins them into one 8-device multi-controller SPMD runtime (SURVEY.md §7
+step 8 — "multi-node without a cluster").  Every process runs the SAME
+program: the device MapReduce engine over the global mesh, then one
+distributed MLP train step.  Success criteria are printed as markers the
+parent test asserts on.
+
+Usage: python multiproc_runner.py <process_id> <num_processes> <port>
+"""
+
+import sys
+
+
+def main() -> int:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs, process_id=pid)
+    assert jax.process_index() == pid
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    print(f"MARKER devices global={n_global} local={n_local}", flush=True)
+
+    from mapreduce_tpu.engine import DeviceWordCount
+    from mapreduce_tpu.models import (
+        DistributedTrainer, MLPConfig, TrainConfig)
+    from mapreduce_tpu.parallel import make_mesh
+
+    mesh = make_mesh()  # all 8 global devices, data axis
+    assert mesh.shape["data"] == n_global
+
+    # 1) the engine: identical text on every process (multi-controller
+    # SPMD contract), counts must match the oracle on every process
+    text = (b"to be or not to be that is the question " * 50
+            + b"whether tis nobler in the mind " * 30)
+    wc = DeviceWordCount(mesh, chunk_len=512)
+    counts = wc.count_bytes(text)
+    expected = {}
+    for w in text.split():
+        expected[w] = expected.get(w, 0) + 1
+    assert counts == expected, (len(counts), len(expected))
+    print(f"MARKER wordcount ok uniques={len(counts)}", flush=True)
+
+    # 2) one distributed train step over the same mesh
+    import numpy as np
+
+    trainer = DistributedTrainer(
+        mesh, MLPConfig(sizes=(32, 16, 10)),
+        TrainConfig(bunch_size=4, max_epochs=1))
+    params, opt_state = trainer.init_state()
+    batch = trainer.cfg.bunch_size * mesh.shape["data"]
+    x = np.random.default_rng(0).normal(size=(batch, 32)).astype(np.float32)
+    y = (np.arange(batch) % 10).astype(np.int32)
+    xd, yd = trainer.place_batch(x, y)
+    params, opt_state, loss = trainer._train_step(params, opt_state, xd, yd)
+    loss = float(loss)  # replicated scalar: addressable everywhere
+    assert np.isfinite(loss)
+    print(f"MARKER trainstep ok loss={loss:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
