@@ -165,6 +165,31 @@ def cmd_wordcount(argv: List[str]) -> int:
     return 0
 
 
+def cmd_blobserver(argv: List[str]) -> int:
+    """Serve a directory as the ``http:HOST:PORT`` storage backend — the
+    central blob service workers on other hosts point their storage DSL
+    at (the cross-host role of the reference's sshfs backend,
+    fs.lua:141-181)."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu blobserver")
+    p.add_argument("root", help="directory to store blobs in")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8750)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+
+    from .storage import BlobServer
+
+    srv = BlobServer(args.root, args.host, args.port)
+    print(f"serving {args.root} at http:{srv.address} "
+          f"(storage DSL: \"http:HOST:{srv.port}\")", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_drop(argv: List[str]) -> int:
     """Drop a task's control-plane collections and (optionally) its
     storage blobs — the reference's remove_results.sh (db.dropDatabase())."""
@@ -197,7 +222,8 @@ def cmd_drop(argv: List[str]) -> int:
 
 
 COMMANDS = {"server": cmd_server, "worker": cmd_worker,
-            "wordcount": cmd_wordcount, "drop": cmd_drop}
+            "wordcount": cmd_wordcount, "drop": cmd_drop,
+            "blobserver": cmd_blobserver}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -4,21 +4,25 @@ The reference exposes a GridFS-shaped API over three backends — GridFS,
 a shared NFS dir, and local-disk+scp "sshfs" (fs.lua:20-25) — selected by a
 storage DSL string and returned by ``fs.router`` (fs.lua:185-208).  The
 rebuild keeps the pluggable-named-blob model for the *general* path (map
-outputs, reduce results, checkpoints live here) with two backends:
+outputs, reduce results, checkpoints live here) with three backends:
 
   * ``mem[:name]``   — in-process named byte store (the unit-test/GridFS
     role; no external service needed, unlike the reference's tests);
   * ``shared:PATH``  — a directory on local disk or NFS, atomic
-    tempfile+rename writes (fs.lua:80-115 file_builder semantics).
+    tempfile+rename writes (fs.lua:80-115 file_builder semantics);
+  * ``http:HOST:PORT`` — a central stdlib blob service
+    (storage/httpstore.py): the cross-host role the reference's
+    scp/"sshfs" backend played (fs.lua:141-181), without ssh keys or an
+    NFS mount.  Start one with ``python -m mapreduce_tpu.cli blobserver``.
 
-The scp/"sshfs" backend has no TPU-native reason to exist: moving bytes
-between hosts is the collectives' job (SURVEY.md §2.9: "none needed:
-ICI/DCN collectives replace file movement"); ``shared`` covers the
-multi-process case.  The device engine bypasses this layer entirely —
-intermediate data stays in HBM.
+Intra-job data movement on the device path needs none of this — moving
+bytes between chips is the collectives' job (SURVEY.md §2.9) and
+intermediate data stays in HBM; this layer is the durable blob plane for
+the general path and checkpoints.
 """
 
 from .base import Storage, FileBuilder  # noqa: F401
 from .memory import MemoryStorage  # noqa: F401
 from .localdir import LocalDirStorage  # noqa: F401
+from .httpstore import BlobServer, HttpStorage  # noqa: F401
 from .router import router, get_storage_from  # noqa: F401

@@ -1,0 +1,185 @@
+"""HTTP object-store backend: storage that spans hosts with no shared fs.
+
+The third backend class the reference supports through ``sshfs`` —
+map output written locally, pulled across machines with ``scp -CB``
+(fs.lua:141-181) — rebuilt as the topology modern clusters actually use:
+one central blob service (the role mongod+GridFS plays for the
+reference's default backend) that every server/worker reaches over HTTP.
+Plain stdlib on both sides; no ssh keys, no NFS mount.
+
+* :class:`BlobServer` — a threading HTTP server over a
+  :class:`LocalDirStorage` root: PUT stages + atomically publishes,
+  GET streams, DELETE removes, ``/list`` enumerates.  Start one with
+  ``python -m mapreduce_tpu.cli blobserver DIR --port N``.
+* :class:`HttpStorage` — the client ``Storage``; DSL
+  ``"http:HOST:PORT"``.  Atomicity holds because the server publishes
+  via tempfile+rename exactly like the shared backend.
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import threading
+import urllib.parse
+from typing import Iterator, List, Optional, Tuple
+
+from .base import Storage
+from .localdir import LocalDirStorage
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: LocalDirStorage  # set by BlobServer
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _name(self) -> Optional[str]:
+        if not self.path.startswith("/blobs/"):
+            return None
+        return urllib.parse.unquote(self.path[len("/blobs/"):])
+
+    def _respond(self, code: int, body: bytes = b"") -> None:
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        if self.path == "/list":
+            # names are quoted per line: arbitrary blob names (including
+            # embedded newlines) must round-trip like the other backends
+            body = "\n".join(urllib.parse.quote(n, safe="")
+                             for n in self.store.list()).encode()
+            return self._respond(200, body)
+        name = self._name()
+        if name is None:
+            return self._respond(404)
+        try:  # read-then-404: no exists/read TOCTOU vs concurrent DELETE
+            content = self.store.read(name)
+        except FileNotFoundError:
+            return self._respond(404)
+        self._respond(200, content.encode())
+
+    def do_HEAD(self) -> None:
+        name = self._name()
+        code = 200 if (name is not None
+                       and self.store.exists(name)) else 404
+        self.send_response(code)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_PUT(self) -> None:
+        name = self._name()
+        if name is None:
+            return self._respond(400)
+        length = int(self.headers.get("Content-Length", 0))
+        content = self.rfile.read(length).decode()
+        self.store.write(name, content)  # tempfile+rename: atomic
+        self._respond(201)
+
+    def do_DELETE(self) -> None:
+        name = self._name()
+        if name is None:
+            return self._respond(400)
+        self.store.remove(name)
+        self._respond(204)
+
+
+class BlobServer:
+    """Serve a LocalDirStorage root over HTTP (threaded, stdlib)."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        handler = type("BoundHandler", (_Handler,),
+                       {"store": LocalDirStorage(root)})
+        self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start_background(self) -> "BlobServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.httpd.server_close()  # release the listening socket now
+
+
+class HttpStorage(Storage):
+    scheme = "http"
+
+    def __init__(self, address: str) -> None:
+        host, _, port = address.partition(":")
+        if not port:
+            raise ValueError(
+                f"http storage wants HOST:PORT, got {address!r}")
+        self.host, self.port = host, int(port)
+        self._cnn: Optional[http.client.HTTPConnection] = None
+        self._lock = threading.Lock()
+
+    def _request(self, method: str, path: str, body: Optional[bytes] = None
+                 ) -> Tuple[int, bytes]:
+        """One keep-alive connection per storage handle (the server speaks
+        HTTP/1.1), re-established once on a stale/broken socket."""
+        with self._lock:
+            for attempt in (0, 1):
+                if self._cnn is None:
+                    self._cnn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=60)
+                try:
+                    self._cnn.request(method, path, body=body)
+                    r = self._cnn.getresponse()
+                    return r.status, r.read()
+                except (http.client.HTTPException, OSError):
+                    self._cnn.close()
+                    self._cnn = None
+                    if attempt:
+                        raise
+            raise AssertionError("unreachable")
+
+    def _blob_path(self, name: str) -> str:
+        return "/blobs/" + urllib.parse.quote(name, safe="")
+
+    def _publish(self, name: str, content: str) -> None:
+        status, _ = self._request("PUT", self._blob_path(name),
+                                  content.encode())
+        if status != 201:
+            raise IOError(f"blob PUT {name!r} failed: HTTP {status}")
+
+    def read(self, name: str) -> str:
+        status, body = self._request("GET", self._blob_path(name))
+        if status != 200:
+            raise FileNotFoundError(f"{name!r}: HTTP {status}")
+        return body.decode()
+
+    def open_lines(self, name: str) -> Iterator[str]:
+        for line in self.read(name).split("\n"):
+            if line:
+                yield line
+
+    def _all_names(self) -> List[str]:
+        status, body = self._request("GET", "/list")
+        if status != 200:
+            raise IOError(f"blob list failed: HTTP {status}")
+        return [urllib.parse.unquote(n)
+                for n in body.decode().split("\n") if n]
+
+    def exists(self, name: str) -> bool:
+        status, _ = self._request("HEAD", self._blob_path(name))
+        return status == 200
+
+    def remove(self, name: str) -> None:
+        self._request("DELETE", self._blob_path(name))

@@ -3,10 +3,13 @@
 Reference: ``utils.get_storage_from`` parses ``"gridfs|shared|sshfs[:PATH]"``
 defaulting to gridfs + os.tmpname (utils.lua:273-285), and ``fs.router``
 returns the backend handle plus builder/line-iterator factories
-(fs.lua:185-208).  Our DSL: ``"mem[:NAME]" | "shared:PATH" | "local:PATH"``
-(local = alias of shared).  There is no sshfs backend — collectives replace
-host-to-host file movement (SURVEY.md §2.9) and ``shared`` covers
-multi-process on one host/NFS.
+(fs.lua:185-208).  Our DSL: ``"mem[:NAME]" | "shared:PATH" | "local:PATH"
+| "http:HOST:PORT"`` (local = alias of shared).  The three backend
+classes map to the reference's three: mem ~ gridfs (central store,
+in-process), shared ~ shared NFS dir, http ~ sshfs's cross-host role —
+a central blob service instead of per-mapper scp pulls (fs.lua:141-181),
+because collectives already replace intra-job file movement
+(SURVEY.md §2.9) and what remains is plain blob transport.
 """
 
 from __future__ import annotations
@@ -29,9 +32,12 @@ def get_storage_from(storage: str = None) -> Tuple[str, str]:
     backend = backend.strip()
     if backend == "local":
         backend = "shared"
-    if backend not in ("mem", "shared"):
+    if backend not in ("mem", "shared", "http"):
         raise ValueError(
-            f"unknown storage backend {backend!r} (want mem|shared|local)")
+            f"unknown storage backend {backend!r} "
+            "(want mem|shared|local|http)")
+    if backend == "http" and (not sep or not path):
+        raise ValueError("http storage wants http:HOST:PORT")
     if not sep or not path:
         path = ("default" if backend == "mem"
                 else tempfile.mkdtemp(prefix="mr_tpu_storage_"))
@@ -43,4 +49,7 @@ def router(storage: str = None) -> Storage:
     backend, path = get_storage_from(storage)
     if backend == "mem":
         return MemoryStorage.named(path)
+    if backend == "http":
+        from .httpstore import HttpStorage
+        return HttpStorage(path)
     return LocalDirStorage(path)

@@ -1,5 +1,6 @@
-"""Storage-layer tests across both backends (reference fs.utest,
-fs.lua:213-251, runs gridfs/shared/sshfs; our matrix is mem/shared)."""
+"""Storage-layer tests across all three backends (reference fs.utest,
+fs.lua:213-251, runs gridfs/shared/sshfs; our matrix is mem/shared/http
+— http is the cross-host blob service playing sshfs's role)."""
 
 import uuid
 
@@ -7,13 +8,18 @@ import pytest
 
 from mapreduce_tpu import storage as storage_mod
 from mapreduce_tpu.storage import (
-    LocalDirStorage, MemoryStorage, get_storage_from, router)
+    BlobServer, HttpStorage, LocalDirStorage, MemoryStorage,
+    get_storage_from, router)
 
 
-@pytest.fixture(params=["mem", "shared"])
+@pytest.fixture(params=["mem", "shared", "http"])
 def store(request, tmp_path):
     if request.param == "mem":
         return MemoryStorage()
+    if request.param == "http":
+        srv = BlobServer(str(tmp_path / "served"), port=0).start_background()
+        request.addfinalizer(srv.shutdown)
+        return HttpStorage(srv.address)
     return LocalDirStorage(str(tmp_path / "blobs"))
 
 
@@ -48,16 +54,24 @@ def test_overwrite_is_atomic_replace(store):
 
 
 def test_names_with_odd_characters(store):
-    # keys become file-name tokens; quoted names must round-trip
-    name = "p/map_results.P00001.Mwe%20ird'key"
-    store.write(name, "v\n")
-    assert store.exists(name)
-    assert name in store.list()
+    # keys become file-name tokens; quoted names must round-trip —
+    # including an embedded newline (the /list wire format must not
+    # split it into phantom names)
+    for name in ("p/map_results.P00001.Mwe%20ird'key", "line\nbreak"):
+        store.write(name, "v\n")
+        assert store.exists(name)
+        assert name in store.list()
 
 
 def test_storage_dsl():
     assert get_storage_from("mem:foo") == ("mem", "foo")
     assert get_storage_from("shared:/tmp/x") == ("shared", "/tmp/x")
+    assert get_storage_from("http:127.0.0.1:8750") == ("http",
+                                                       "127.0.0.1:8750")
+    with pytest.raises(ValueError):
+        get_storage_from("http")  # needs HOST:PORT
+    with pytest.raises(ValueError):
+        HttpStorage("nohostport")
     assert get_storage_from("local:/tmp/x") == ("shared", "/tmp/x")
     backend, path = get_storage_from(None)
     assert backend == "mem" and path
