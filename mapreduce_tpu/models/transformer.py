@@ -42,6 +42,15 @@ class TransformerConfig:
     head_dim: int = 16
     ffn: int = 512
     dtype: Any = jnp.bfloat16
+    #: rematerialize each layer in the backward pass (jax.checkpoint):
+    #: activation memory drops from O(n_layers) to O(1) layers, buying
+    #: ~4x longer context per device for ~30% recompute — the standard
+    #: long-context trade (HBM is the bottleneck, not FLOPs)
+    remat: bool = False
+    #: flash-style chunking of each ring step's local attention: scores
+    #: materialise [T_local, attn_block] instead of [T_local, T_local]
+    #: (parallel/ring.py block_size); None = unchunked
+    attn_block: Any = None
 
     def validate(self, n_model: int) -> None:
         assert self.n_heads % n_model == 0, "heads must split over model axis"
@@ -91,40 +100,51 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
 
+def _layer_local(x: jax.Array, lp: Params, cfg: TransformerConfig,
+                 n_model: int, data_axis: str, model_axis: str):
+    """One transformer block on the local sequence shard (inside
+    shard_map); ``lp`` holds this layer's params without the L<i> prefix."""
+    H_loc = cfg.n_heads // n_model
+    D = cfg.head_dim
+    h = _rmsnorm(x, lp["ln1_scale"].astype(cfg.dtype))
+    qkv = jnp.einsum("bte,ecf->btcf", h, lp["wqkv"].astype(cfg.dtype))
+    q, k, v = [qkv[:, :, j].reshape(*qkv.shape[:2], H_loc, D)
+               for j in range(3)]
+    # bf16 operands on the MXU with f32 softmax/accumulation inside
+    attn = ring_attention(q, k, v, data_axis, causal=True,
+                          block_size=cfg.attn_block).astype(cfg.dtype)
+    attn = attn.reshape(*attn.shape[:2], H_loc * D)
+    # row-sharded output projection -> psum over the model axis
+    o = jnp.einsum("btf,fe->bte", attn, lp["wo"].astype(cfg.dtype))
+    o = jax.lax.psum(o.astype(jnp.float32), model_axis)
+    x = x + o.astype(cfg.dtype)
+
+    h = _rmsnorm(x, lp["ln2_scale"].astype(cfg.dtype))
+    u = jnp.einsum("bte,ef->btf", h, lp["w_in"].astype(cfg.dtype))
+    u = jax.nn.gelu(u)
+    m = jnp.einsum("btf,fe->bte", u, lp["w_out"].astype(cfg.dtype))
+    m = jax.lax.psum(m.astype(jnp.float32), model_axis)
+    return x + m.astype(cfg.dtype)
+
+
 def forward_local(params: Params, tokens: jax.Array,
                   cfg: TransformerConfig, n_model: int,
                   data_axis: str = "data", model_axis: str = "model"):
     """Local-block forward INSIDE shard_map: ``tokens`` [B, T_local]
     int32; returns hidden states [B, T_local, E] (f32).  Params arrive
     already sliced by transformer_param_spec."""
-    H_loc = cfg.n_heads // n_model
-    D = cfg.head_dim
     x = params["embed"][tokens].astype(cfg.dtype)  # [B, T, E]
-    for i in range(cfg.n_layers):
-        h = _rmsnorm(x, params[f"L{i}.ln1_scale"].astype(cfg.dtype))
-        qkv = jnp.einsum("bte,ecf->btcf", h,
-                         params[f"L{i}.wqkv"].astype(cfg.dtype))
-        q, k, v = [qkv[:, :, j].reshape(*qkv.shape[:2], H_loc, D)
-                   for j in range(3)]
-        attn = ring_attention(q.astype(jnp.float32),
-                              k.astype(jnp.float32),
-                              v.astype(jnp.float32), data_axis,
-                              causal=True).astype(cfg.dtype)
-        attn = attn.reshape(*attn.shape[:2], H_loc * D)
-        # row-sharded output projection -> psum over the model axis
-        o = jnp.einsum("btf,fe->bte", attn,
-                       params[f"L{i}.wo"].astype(cfg.dtype))
-        o = jax.lax.psum(o.astype(jnp.float32), model_axis)
-        x = x + o.astype(cfg.dtype)
 
-        h = _rmsnorm(x, params[f"L{i}.ln2_scale"].astype(cfg.dtype))
-        u = jnp.einsum("bte,ef->btf", h,
-                       params[f"L{i}.w_in"].astype(cfg.dtype))
-        u = jax.nn.gelu(u)
-        m = jnp.einsum("btf,fe->bte", u,
-                       params[f"L{i}.w_out"].astype(cfg.dtype))
-        m = jax.lax.psum(m.astype(jnp.float32), model_axis)
-        x = x + m.astype(cfg.dtype)
+    def layer(x, lp):
+        return _layer_local(x, lp, cfg, n_model, data_axis, model_axis)
+
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+    for i in range(cfg.n_layers):
+        prefix = f"L{i}."
+        lp = {k[len(prefix):]: v for k, v in params.items()
+              if k.startswith(prefix)}
+        x = layer(x, lp)
     return x.astype(jnp.float32)
 
 
@@ -138,7 +158,11 @@ def loss_local(params: Params, tokens: jax.Array, targets: jax.Array,
     block (host pre-shifts across shard boundaries)."""
     x = forward_local(params, tokens, cfg, n_model, data_axis, model_axis)
     w = params["unembed"]  # [E, V_loc]
-    logits = jnp.einsum("bte,ev->btv", x, w)  # f32 [B, T, V_loc]
+    # the unembed matmul is ~20% of model FLOPs at vocab 32k: bf16
+    # operands on the MXU, f32 accumulation for the softmax statistics
+    logits = jnp.einsum("bte,ev->btv", x.astype(cfg.dtype),
+                        w.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
     # stop_gradient BEFORE pmax: the shift is gradient-neutral (logsumexp
     # identity), pmax has no JVP rule, and as a reduction it also makes
     # the max invariant over the model axis for vma inference

@@ -29,9 +29,14 @@ def _block_attn(q, k, v, mask, scale):
 
     Returns ``(block_max [B,H,Tq], exp-weights sum [B,H,Tq],
     weighted V [B,Tq,H,D])`` — un-normalised pieces for the accumulator.
+
+    Mixed precision: the two matmuls run in the INPUT dtype (bf16 keeps
+    them on the MXU fast path) with f32 accumulation
+    (preferred_element_type); softmax statistics are always f32.
     """
-    # [B, H, Tq, Tk]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # [B, H, Tq, Tk] — f32 accumulation regardless of operand dtype
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     scores = jnp.where(mask, scores, -jnp.inf)
     m = scores.max(axis=-1)  # [B, H, Tq]
     # guard fully-masked rows (all -inf): exp(-inf - -inf) would be NaN
@@ -39,62 +44,104 @@ def _block_attn(q, k, v, mask, scale):
     p = jnp.exp(scores - safe_m[..., None])
     p = jnp.where(mask, p, 0.0)
     den = p.sum(axis=-1)  # [B, H, Tq]
-    num = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    num = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return safe_m, den, num
+
+
+def _combine(m, den, num, bm, bden, bnum):
+    """Fold one partial-attention block into the online-softmax
+    accumulator (associative, so ring steps and local chunks share it)."""
+    new_m = jnp.maximum(m, bm)
+    corr_old = jnp.exp(m - new_m)
+    corr_new = jnp.exp(bm - new_m)
+    den = den * corr_old + bden * corr_new
+    # broadcast the [B,H,T] corrections over the [B,T,H,D] accumulator
+    num = (num * jnp.moveaxis(corr_old, 1, 2)[..., None]
+           + bnum * jnp.moveaxis(corr_new, 1, 2)[..., None])
+    return new_m, den, num
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   block_size: Optional[int] = None) -> jax.Array:
     """Exact multi-head attention over a sequence sharded on *axis_name*.
 
     ``q/k/v``: [B, T_local, H, D] local blocks (must run inside
     ``shard_map``).  Returns [B, T_local, H, D].
+
+    ``block_size`` additionally chunks each ring step's LOCAL attention
+    (flash-attention style): scores materialise as [B, H, T_local, block]
+    instead of [B, H, T_local, T_local], with each chunk rematerialised
+    in the backward pass — O(T_local * block) attention memory, the
+    single-device half of the long-context story (the ring supplies the
+    cross-device half).  Must divide T_local; None = one chunk (exact
+    same math either way: the online-softmax combine is associative).
     """
     P = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block = block_size or T
+    if T % block != 0:
+        raise ValueError(f"block_size {block} must divide T_local {T}")
+    C = T // block
 
     q_pos = rank * T + jnp.arange(T)  # global positions of my queries
+
+    def chunk_step(carry, xs):
+        m, den, num = carry
+        kb, vb, pos = xs  # [B, block, H, D] x2, [block]
+        if causal:
+            mask = pos[None, :] <= q_pos[:, None]  # [Tq, block]
+        else:
+            mask = jnp.ones((T, block), bool)
+        bm, bden, bnum = _block_attn(q, kb, vb, mask[None, None], scale)
+        return _combine(m, den, num, bm, bden, bnum), None
+
+    if C > 1:
+        # recompute each chunk's scores in the backward pass instead of
+        # saving them — the standard flash memory/compute trade
+        chunk_step = jax.checkpoint(chunk_step)
 
     def step(carry, s):
         k_blk, v_blk, m, den, num = carry
         # the block currently held arrived from rank - s (ring order)
         src = (rank - s) % P
         kv_pos = src * T + jnp.arange(T)
-        if causal:
-            mask = kv_pos[None, :] <= q_pos[:, None]   # [Tq, Tk]
+        if C == 1:
+            (m, den, num), _ = chunk_step((m, den, num),
+                                          (k_blk, v_blk, kv_pos))
         else:
-            mask = jnp.ones((T, T), bool)
-        bm, bden, bnum = _block_attn(q, k_blk, v_blk,
-                                     mask[None, None], scale)
-        new_m = jnp.maximum(m, bm)
-        corr_old = jnp.exp(m - new_m)
-        corr_new = jnp.exp(bm - new_m)
-        den = den * corr_old + bden * corr_new
-        # broadcast the [B,H,T] corrections over the [B,T,H,D] accumulator
-        num = (num * jnp.moveaxis(corr_old, 1, 2)[..., None]
-               + bnum * jnp.moveaxis(corr_new, 1, 2)[..., None])
+            chunks = (
+                jnp.moveaxis(k_blk.reshape(B, C, block, H, D), 1, 0),
+                jnp.moveaxis(v_blk.reshape(B, C, block, H, D), 1, 0),
+                kv_pos.reshape(C, block),
+            )
+            (m, den, num), _ = jax.lax.scan(chunk_step, (m, den, num),
+                                            chunks)
         # rotate K/V to the next device; after P-1 rotations every device
         # has seen every block
         perm = [(i, (i + 1) % P) for i in range(P)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, new_m, den, num), None
+        return (k_blk, v_blk, m, den, num), None
 
     # the scan carry must enter with the same device-varying type the body
     # produces; deriving the zero accumulators from q inherits q's vma
-    # regardless of how many mesh axes enclose us (sp alone, or sp x tp)
-    stat0 = jnp.moveaxis(q[..., 0] * 0.0, 1, 2)  # [B, H, T] zeros
-    m0 = stat0 - jnp.inf
+    # regardless of how many mesh axes enclose us (sp alone, or sp x tp).
+    # Accumulators are f32 even for bf16 inputs (online-softmax stats and
+    # the weighted-V sum must not round per ring step).
+    stat0 = jnp.moveaxis(q[..., 0].astype(jnp.float32) * 0.0, 1, 2)
+    m0 = stat0 - jnp.inf      # [B, H, T]
     den0 = stat0
-    num0 = q * 0.0
+    num0 = q.astype(jnp.float32) * 0.0
     (k_f, v_f, m, den, num), _ = jax.lax.scan(
         step, (k, v, m0, den0, num0), jnp.arange(P))
 
     den = jnp.moveaxis(den, 1, 2)[..., None]  # [B, T, H, 1]
-    return num / jnp.maximum(den, 1e-20)
+    return (num / jnp.maximum(den, 1e-20)).astype(q.dtype)
 
 
 def full_attention_reference(q, k, v, causal: bool = True,

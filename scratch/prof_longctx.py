@@ -1,0 +1,28 @@
+"""Long-context ceiling on one real chip with remat + chunked attention."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+
+mesh = make_mesh()
+for T in (8192, 16384, 32768, 65536, 131072):
+    cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                            n_heads=16, head_dim=64, ffn=4096,
+                            remat=True, attn_block=1024)
+    try:
+        tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+        params = tr.init_params()
+        toks = np.random.default_rng(0).integers(
+            0, cfg.vocab, size=(1, T + 1)).astype(np.int32)
+        params, loss = tr.step(params, toks); lv = float(loss)
+        t1 = time.time()
+        params, loss = tr.step(params, toks); lv = float(loss)
+        dt = time.time() - t1
+        print(f"T={T}: OK {dt:.2f}s/step ({T/dt:.0f} tok/s) loss={lv:.2f}",
+              flush=True)
+        del params, tr
+    except Exception as e:
+        print(f"T={T}: FAIL {str(e).split(chr(10))[0][:90]}", flush=True)
+        break

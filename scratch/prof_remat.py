@@ -1,0 +1,34 @@
+"""How much context does remat buy on the real chip? Try doubling T
+until OOM, with and without remat."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+
+mesh = make_mesh()
+for remat in (False, True):
+    for T in (4096, 8192, 16384, 32768, 65536):
+        cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                                n_heads=16, head_dim=64, ffn=4096,
+                                remat=remat)
+        try:
+            tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+            params = tr.init_params()
+            toks = np.random.default_rng(0).integers(
+                0, cfg.vocab, size=(2, T + 1)).astype(np.int32)
+            t0 = time.time()
+            params, loss = tr.step(params, toks)
+            lv = float(loss)
+            t1 = time.time()
+            params, loss = tr.step(params, toks)
+            lv = float(loss)
+            dt = time.time() - t1
+            print(f"remat={remat} T={T}: OK {dt:.2f}s/step "
+                  f"({2*T/dt:.0f} tok/s)", flush=True)
+            del params
+        except Exception as e:
+            msg = str(e).split("\n")[0][:100]
+            print(f"remat={remat} T={T}: FAIL {msg}", flush=True)
+            break

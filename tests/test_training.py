@@ -120,3 +120,23 @@ def test_train_digits_through_job_board():
     assert stats["iteration"] == 3
     # map phase ran n_shards jobs per iteration, none failed
     assert stats["map"]["count"] == 4 and stats["map"]["failed"] == 0
+
+
+def test_fit_dataset_smaller_than_global_batch():
+    """A dataset smaller than HALF the global batch must still train via
+    wrap-around (regression: the fused-epoch rewrite extended the
+    permutation by at most n samples and crashed on reshape)."""
+    import numpy as np
+    from mapreduce_tpu.models import (
+        DistributedTrainer, MLPConfig, TrainConfig)
+    from mapreduce_tpu.parallel import make_mesh
+
+    mesh = make_mesh()  # data=8 -> global_batch = 8 * 8 = 64 > 2 * 24
+    tr = DistributedTrainer(mesh, MLPConfig(sizes=(16, 8, 4)),
+                            TrainConfig(bunch_size=8, max_epochs=2,
+                                        min_epochs=1, patience=1))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(24, 16)).astype(np.float32)
+    y = (np.arange(24) % 4).astype(np.int32)
+    out = tr.fit(x, y, x, y)
+    assert np.isfinite(out["history"][-1]["train_loss"])
