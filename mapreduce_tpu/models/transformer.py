@@ -51,6 +51,12 @@ class TransformerConfig:
     #: materialise [T_local, attn_block] instead of [T_local, T_local]
     #: (parallel/ring.py block_size); None = unchunked
     attn_block: Any = None
+    #: sequence-chunked cross-entropy: logits materialise
+    #: [B, loss_block, V/n_model] instead of [B, T_local, V/n_model] —
+    #: at vocab 32k and T 64k the full logits alone are ~8GB f32, THE
+    #: single-chip long-context blocker once attention is chunked.
+    #: None = unchunked; must divide T_local
+    loss_block: Any = None
 
     def validate(self, n_model: int) -> None:
         assert self.n_heads % n_model == 0, "heads must split over model axis"
@@ -158,27 +164,50 @@ def loss_local(params: Params, tokens: jax.Array, targets: jax.Array,
     block (host pre-shifts across shard boundaries)."""
     x = forward_local(params, tokens, cfg, n_model, data_axis, model_axis)
     w = params["unembed"]  # [E, V_loc]
-    # the unembed matmul is ~20% of model FLOPs at vocab 32k: bf16
-    # operands on the MXU, f32 accumulation for the softmax statistics
-    logits = jnp.einsum("bte,ev->btv", x.astype(cfg.dtype),
-                        w.astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    # stop_gradient BEFORE pmax: the shift is gradient-neutral (logsumexp
-    # identity), pmax has no JVP rule, and as a reduction it also makes
-    # the max invariant over the model axis for vma inference
-    local_max = jax.lax.stop_gradient(logits.max(axis=-1))  # [B, T]
-    gmax = jax.lax.pmax(local_max, model_axis)
-    z = jnp.exp(logits - gmax[..., None])
-    denom = jax.lax.psum(z.sum(axis=-1), model_axis)
-    # my shard's slice of the one-hot target
-    V_loc = logits.shape[-1]
-    shard = jax.lax.axis_index(model_axis)
-    local_t = targets - shard * V_loc
-    in_shard = (local_t >= 0) & (local_t < V_loc)
-    t_logit = jnp.take_along_axis(
-        logits, jnp.clip(local_t, 0, V_loc - 1)[..., None], axis=-1)[..., 0]
-    t_logit = jax.lax.psum(jnp.where(in_shard, t_logit, 0.0), model_axis)
-    nll = (gmax + jnp.log(denom)) - t_logit
+
+    def chunk_nll(x_c, t_c):
+        """[B, Tc, E] hidden + [B, Tc] global targets -> [B, Tc] nll.
+        The unembed matmul is ~20% of model FLOPs at vocab 32k: bf16
+        operands on the MXU, f32 accumulation for the softmax stats."""
+        logits = jnp.einsum("bte,ev->btv", x_c.astype(cfg.dtype),
+                            w.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        # stop_gradient BEFORE pmax: the shift is gradient-neutral
+        # (logsumexp identity), pmax has no JVP rule, and as a reduction
+        # it also makes the max invariant over the model axis for vma
+        # inference
+        local_max = jax.lax.stop_gradient(logits.max(axis=-1))  # [B, Tc]
+        gmax = jax.lax.pmax(local_max, model_axis)
+        z = jnp.exp(logits - gmax[..., None])
+        denom = jax.lax.psum(z.sum(axis=-1), model_axis)
+        # my shard's slice of the one-hot target
+        V_loc = logits.shape[-1]
+        shard = jax.lax.axis_index(model_axis)
+        local_t = t_c - shard * V_loc
+        in_shard = (local_t >= 0) & (local_t < V_loc)
+        t_logit = jnp.take_along_axis(
+            logits, jnp.clip(local_t, 0, V_loc - 1)[..., None],
+            axis=-1)[..., 0]
+        t_logit = jax.lax.psum(jnp.where(in_shard, t_logit, 0.0),
+                               model_axis)
+        return (gmax + jnp.log(denom)) - t_logit
+
+    Tc = cfg.loss_block
+    if Tc is None:
+        nll = chunk_nll(x, targets)
+    else:
+        B, T, E = x.shape
+        if T % Tc != 0:
+            raise ValueError(f"loss_block {Tc} must divide T_local {T}")
+        C = T // Tc
+        xs = jnp.moveaxis(x.reshape(B, C, Tc, E), 1, 0)
+        ts = jnp.moveaxis(targets.reshape(B, C, Tc), 1, 0)
+        # recompute each chunk's logits in the backward pass — full
+        # logits never exist in memory, forward or backward
+        body = jax.checkpoint(
+            lambda _, xt: (None, chunk_nll(*xt)))
+        _, nll_chunks = jax.lax.scan(body, None, (xs, ts))
+        nll = jnp.moveaxis(nll_chunks, 0, 1).reshape(B, T)
     return jax.lax.pmean(nll.mean(), data_axis)
 
 

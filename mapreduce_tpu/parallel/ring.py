@@ -72,12 +72,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``shard_map``).  Returns [B, T_local, H, D].
 
     ``block_size`` additionally chunks each ring step's LOCAL attention
-    (flash-attention style): scores materialise as [B, H, T_local, block]
-    instead of [B, H, T_local, T_local], with each chunk rematerialised
-    in the backward pass — O(T_local * block) attention memory, the
-    single-device half of the long-context story (the ring supplies the
-    cross-device half).  Must divide T_local; None = one chunk (exact
-    same math either way: the online-softmax combine is associative).
+    (flash-attention style) over BOTH the query and key/value axes:
+    scores materialise as [B, H, block, block] instead of
+    [B, H, T_local, T_local], with each tile rematerialised in the
+    backward pass — O(block²) attention memory regardless of T_local,
+    the single-device half of the long-context story (the ring supplies
+    the cross-device half).  Must divide T_local; None = one chunk
+    (exact same math either way: the online-softmax combine is
+    associative).
     """
     P = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -90,20 +92,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     q_pos = rank * T + jnp.arange(T)  # global positions of my queries
 
-    def chunk_step(carry, xs):
-        m, den, num = carry
-        kb, vb, pos = xs  # [B, block, H, D] x2, [block]
+    def tile_step(carry, xs, q_c, qp_c):
+        """Fold one KV tile into one Q chunk's accumulator."""
+        m_c, den_c, num_c = carry
+        kb, vb, kp = xs  # [B, block, H, D] x2, [block]
         if causal:
-            mask = pos[None, :] <= q_pos[:, None]  # [Tq, block]
+            mask = kp[None, :] <= qp_c[:, None]  # [Tq_c, Tk_c]
         else:
-            mask = jnp.ones((T, block), bool)
-        bm, bden, bnum = _block_attn(q, kb, vb, mask[None, None], scale)
-        return _combine(m, den, num, bm, bden, bnum), None
-
-    if C > 1:
-        # recompute each chunk's scores in the backward pass instead of
-        # saving them — the standard flash memory/compute trade
-        chunk_step = jax.checkpoint(chunk_step)
+            mask = jnp.ones((qp_c.shape[0], kp.shape[0]), bool)
+        bm, bden, bnum = _block_attn(q_c, kb, vb, mask[None, None], scale)
+        return _combine(m_c, den_c, num_c, bm, bden, bnum), None
 
     def step(carry, s):
         k_blk, v_blk, m, den, num = carry
@@ -111,16 +109,36 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         src = (rank - s) % P
         kv_pos = src * T + jnp.arange(T)
         if C == 1:
-            (m, den, num), _ = chunk_step((m, den, num),
-                                          (k_blk, v_blk, kv_pos))
+            (m, den, num), _ = tile_step((m, den, num),
+                                         (k_blk, v_blk, kv_pos),
+                                         q, q_pos)
         else:
-            chunks = (
-                jnp.moveaxis(k_blk.reshape(B, C, block, H, D), 1, 0),
-                jnp.moveaxis(v_blk.reshape(B, C, block, H, D), 1, 0),
-                kv_pos.reshape(C, block),
-            )
-            (m, den, num), _ = jax.lax.scan(chunk_step, (m, den, num),
-                                            chunks)
+            # flash tiling: outer scan over Q chunks (each with its own
+            # accumulator slice), inner scan over KV tiles; each tile
+            # recomputed in the backward pass (jax.checkpoint) so only
+            # one [B, H, block, block] score tile ever exists
+            kc = jnp.moveaxis(k_blk.reshape(B, C, block, H, D), 1, 0)
+            vc = jnp.moveaxis(v_blk.reshape(B, C, block, H, D), 1, 0)
+            kp_c = kv_pos.reshape(C, block)
+
+            def q_step(_, xs):
+                q_c, qp_c, m_c, den_c, num_c = xs
+                inner = jax.checkpoint(
+                    lambda cry, ys: tile_step(cry, ys, q_c, qp_c))
+                (m_c, den_c, num_c), _ = jax.lax.scan(
+                    inner, (m_c, den_c, num_c), (kc, vc, kp_c))
+                return None, (m_c, den_c, num_c)
+
+            qc = jnp.moveaxis(q.reshape(B, C, block, H, D), 1, 0)
+            qp = q_pos.reshape(C, block)
+            mc = jnp.moveaxis(m.reshape(B, H, C, block), 2, 0)
+            denc = jnp.moveaxis(den.reshape(B, H, C, block), 2, 0)
+            numc = jnp.moveaxis(num.reshape(B, C, block, H, D), 1, 0)
+            _, (mc, denc, numc) = jax.lax.scan(
+                q_step, None, (qc, qp, mc, denc, numc))
+            m = jnp.moveaxis(mc, 0, 2).reshape(B, H, T)
+            den = jnp.moveaxis(denc, 0, 2).reshape(B, H, T)
+            num = jnp.moveaxis(numc, 0, 1).reshape(B, T, H, D)
         # rotate K/V to the next device; after P-1 rotations every device
         # has seen every block
         perm = [(i, (i + 1) % P) for i in range(P)]

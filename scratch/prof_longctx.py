@@ -7,10 +7,10 @@ from mapreduce_tpu.parallel import make_mesh
 from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
 
 mesh = make_mesh()
-for T in (8192, 16384, 32768, 65536, 131072):
+for T in (32768,):
     cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
                             n_heads=16, head_dim=64, ffn=4096,
-                            remat=True, attn_block=1024)
+                            remat=True, attn_block=1024, loss_block=2048)
     try:
         tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
         params = tr.init_params()

@@ -1,0 +1,17 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+mesh = make_mesh()
+cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                        n_heads=16, head_dim=64, ffn=4096,
+                        remat=True, attn_block=1024, loss_block=2048)
+tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+params = tr.init_params()
+T = 65536
+toks = np.random.default_rng(0).integers(0, cfg.vocab, size=(1, T + 1)).astype(np.int32)
+try:
+    params, loss = tr.step(params, toks); print("loss", float(loss))
+except Exception as e:
+    print("FAIL:", str(e)[:2000])

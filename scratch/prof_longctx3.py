@@ -1,0 +1,24 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+mesh = make_mesh()
+
+def try_cfg(T, layers, tag):
+    cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=layers,
+                            n_heads=16, head_dim=64, ffn=4096,
+                            remat=True, attn_block=1024, loss_block=2048)
+    try:
+        tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+        params = tr.init_params()
+        toks = np.random.default_rng(0).integers(
+            0, cfg.vocab, size=(1, T + 1)).astype(np.int32)
+        t0=time.time(); params, loss = tr.step(params, toks); lv=float(loss)
+        t1=time.time(); params, loss = tr.step(params, toks); lv=float(loss)
+        print(f"{tag}: OK step {time.time()-t1:.2f}s loss {lv:.2f}", flush=True)
+    except Exception as e:
+        print(f"{tag}: FAIL {str(e)[:120]}", flush=True)
+
+try_cfg(49152, 8, "T=49152 L8")
+try_cfg(65536, 2, "T=65536 L2")

@@ -183,3 +183,27 @@ def test_transformer_attn_block_trains():
         params, loss = trainer.step(params, toks)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_transformer_loss_block_matches_unchunked():
+    """Sequence-chunked cross-entropy must equal the unchunked loss (and
+    its gradients): logits chunks recompute in backward, math unchanged."""
+    from dataclasses import replace
+
+    mesh = make_mesh(n_model=2)
+    cfg = TransformerConfig(vocab=32, embed=32, n_layers=1, n_heads=4,
+                            head_dim=8, ffn=64, dtype=jnp.float32)
+    rng = np.random.default_rng(3)
+    # T=32 over data=4 -> T_local=8; loss_block=2 -> C=4 chunks, so the
+    # multi-chunk scan/reassembly path genuinely runs
+    toks = _batch(rng, cfg, B=2, T=32)
+
+    results = {}
+    for tc in (None, 2):
+        c = replace(cfg, loss_block=tc)
+        trainer = TransformerTrainer(mesh, c, learning_rate=1e-2)
+        params = trainer.init_params()
+        params, loss0 = trainer.step(params, toks)
+        _, loss1 = trainer.step(params, toks)
+        results[tc] = (float(loss0), float(loss1))
+    assert np.allclose(results[None], results[2], rtol=1e-6), results
