@@ -146,6 +146,31 @@ def test_device_crash_resume_at_reduce(corpus):
     assert server.task.finished()
 
 
+def test_workers_idle_through_device_phase(corpus):
+    """Workers polling a device-plane task must find nothing claimable
+    (the __device__ job is RUNNING, owned by the server), idle, and exit
+    cleanly — mixed deployments where worker processes are always
+    running must not break device tasks."""
+    connstr = f"mem://{uuid.uuid4().hex}"
+    server = Server(connstr, "wc")
+    server.configure(_params(corpus, device=True))
+    # generous max_iter: workers must still be polling when the task
+    # reaches MAP, however slowly loop() gets there on a loaded host —
+    # otherwise this test is vacuous (workers give up during WAIT)
+    threads = spawn_worker_threads(connstr, "wc", 2,
+                                   conf={"max_iter": 400,
+                                         "max_sleep": 0.05})
+    server.loop()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    from mapreduce_tpu.examples.wordcount import RESULT
+    assert dict(RESULT) == naive.wordcount(corpus)
+    # nobody stole or broke the device job
+    docs = server.cnn.connect().find(server.task.map_jobs_ns())
+    assert [d["worker"] for d in docs] == ["server"]
+
+
 def test_device_phase_clears_stale_result_partitions(corpus):
     """A crashed host-plane run can leave WRITTEN result partitions; a
     device-plane resume must clear them, or _result_pairs would merge
