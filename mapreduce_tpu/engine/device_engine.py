@@ -320,8 +320,26 @@ class DeviceEngine:
             pool.shutdown(wait=False)
         return wave_list, np.int32(S)
 
+    def stage_inputs(self, chunks: np.ndarray, waves: int = None):
+        """Issue and COMPLETE the host->device transfer of *chunks*,
+        returning an opaque staged handle for :meth:`run`.
+
+        Exists because upload and compute can be legitimately decoupled:
+        a cold client's first transfers happen before any program has
+        executed (on the tunnelled dev platform that path measures
+        ~25-50x faster — see scratch/prof_poison3.py), and a user
+        streaming a corpus can stage the next batch while deciding what
+        to run.  ``run(chunks, staged=...)`` then charges no upload."""
+        W = self._auto_waves(chunks) if waves is None else max(1, waves)
+        wave_inputs, n_real = self._shard_inputs(chunks, W)
+        resolved = [wi if isinstance(wi, tuple) else wi.result()
+                    for wi in wave_inputs]
+        jax.block_until_ready([a for pair in resolved for a in pair])
+        return resolved, n_real
+
     def run(self, chunks: np.ndarray, max_retries: int = 3,
-            timings: dict = None, waves: int = None) -> DeviceResult:
+            timings: dict = None, waves: int = None,
+            staged=None) -> DeviceResult:
         """Execute over *chunks* ([S, ...] host array, sharded over the
         mesh), growing capacities until no stage overflowed.
 
@@ -338,15 +356,27 @@ class DeviceEngine:
         (server.lua:555-600).  With waves > 1 the stages overlap:
         ``upload_s`` is the wall time until every input shard was
         resident, ``compute_s`` the remaining tail until all programs
-        finished."""
+        finished.
+
+        With ``staged`` (from :meth:`stage_inputs`) the *chunks* and
+        *waves* arguments are ignored: the staged handle fixes both the
+        data and its wave split, and no upload is charged to timings."""
+        if staged is not None and waves is not None:
+            raise ValueError(
+                "run(staged=...) uses the handle's wave split; "
+                "pass waves to stage_inputs instead")
         import time
 
-        W = self._auto_waves(chunks) if waves is None else max(1, waves)
         cfg = self.config
         t_start = time.time()
-        # input transfer does not depend on capacities: issue it once, not
-        # once per retry
-        wave_inputs, n_real = self._shard_inputs(chunks, W)
+        if staged is not None:
+            pre_resolved, n_real = staged
+            wave_inputs = list(pre_resolved)
+        else:
+            W = self._auto_waves(chunks) if waves is None else max(1, waves)
+            # input transfer does not depend on capacities: issue it
+            # once, not once per retry
+            wave_inputs, n_real = self._shard_inputs(chunks, W)
         W = len(wave_inputs)  # may have been clamped to data-bearing waves
         resolved = {}
 
@@ -406,7 +436,8 @@ class DeviceEngine:
         t_readback = time.time() - t0
         if timings is not None:
             timings["waves"] = W
-            timings["upload_s"] = round(t_upload, 3)
+            if staged is None:  # staged callers timed the upload already
+                timings["upload_s"] = round(t_upload, 3)
             timings["compute_s"] = round(t_compute, 3)
             timings["readback_s"] = round(t_readback, 3)
             timings["total_s"] = round(time.time() - t_start, 3)

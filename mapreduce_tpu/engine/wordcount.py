@@ -161,11 +161,9 @@ class DeviceWordCount:
         import time
 
         t0 = time.time()
-        n_chunks = max(1, -(-len(data) // self.chunk_len))
-        # round chunks up to a mesh multiple so every device participates
-        n_dev = self.mesh.shape["data"]
-        n_chunks = -(-n_chunks // n_dev) * n_dev
-        chunks, L = shard_text(data, n_chunks, pad_multiple=self.config.tile)
+        # chunk count rounds up to a mesh multiple so every device
+        # participates
+        chunks, L = self._to_chunks(data)
         t_split = time.time() - t0
         result = self._engine_for(L).run(chunks, timings=timings,
                                          waves=waves)
@@ -186,6 +184,39 @@ class DeviceWordCount:
             with open(p, "rb") as f:
                 parts.append(f.read())
         return self.count_bytes(b"\n".join(parts))
+
+    # -- decoupled upload (DeviceEngine.stage_inputs rationale) ------------
+
+    def stage(self, data: bytes, waves: Optional[int] = None):
+        """Ship *data*'s chunks to the device now; count later with
+        :meth:`count_staged`.  Returns an opaque staged handle."""
+        chunks, L = self._to_chunks(data)
+        staged = self._engine_for(L).stage_inputs(chunks, waves)
+        return chunks, L, staged
+
+    def count_staged(self, handle,
+                     timings: Optional[dict] = None) -> Dict[bytes, int]:
+        """Count a corpus previously uploaded with :meth:`stage`."""
+        import time
+
+        chunks, L, staged = handle
+        result = self._engine_for(L).run(chunks, timings=timings,
+                                         staged=staged)
+        if result.overflow:
+            raise RuntimeError(
+                f"wordcount overflowed capacities by {result.overflow} "
+                "rows even after retries; raise EngineConfig capacities")
+        t0 = time.time()
+        out = materialize_counts(chunks, result)
+        if timings is not None:
+            timings["materialize_s"] = round(time.time() - t0, 3)
+        return out
+
+    def _to_chunks(self, data: bytes):
+        n_chunks = max(1, -(-len(data) // self.chunk_len))
+        n_dev = self.mesh.shape["data"]
+        n_chunks = -(-n_chunks // n_dev) * n_dev
+        return shard_text(data, n_chunks, pad_multiple=self.config.tile)
 
 
 def materialize_counts(chunks: np.ndarray, result) -> Dict[bytes, int]:

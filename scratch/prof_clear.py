@@ -1,0 +1,41 @@
+"""Does tearing down and rebuilding the backend restore fast transfers?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import bench
+from mapreduce_tpu.ops.tokenize import shard_text
+
+import jax.extend.backend
+
+corpus = bench.make_corpus()
+chunks, L = shard_text(corpus, 94, pad_multiple=512)
+
+def put(tag):
+    from mapreduce_tpu.parallel import make_mesh
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1), ("model", "data"))
+    sh = NamedSharding(mesh, P("data"))
+    t0 = time.time()
+    out = jax.device_put(chunks, sh)
+    jax.block_until_ready(out)
+    print(f"{tag:36s} {time.time()-t0:6.2f}s", flush=True)
+    return out
+
+x = put("fresh put")
+f = jax.jit(lambda x: x.astype(jnp.int32).sum())
+print("consume:", int(np.asarray(f(x))), flush=True)
+del x
+y = put("post-execution put")
+del y, f
+
+t0 = time.time()
+jax.extend.backend.clear_backends()
+print(f"clear_backends {time.time()-t0:.2f}s", flush=True)
+z = put("put after clear_backends")
+g = jax.jit(lambda x: x.astype(jnp.int32).sum())
+t0 = time.time()
+print("consume:", int(np.asarray(g(z))),
+      f"({time.time()-t0:.2f}s incl recompile)", flush=True)
+del z
+put("post-execution put 2")

@@ -1,0 +1,29 @@
+"""Is the pre-program 'fast put' a deferred transfer? Time a compute that
+consumes the uploaded data, with value readback."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import bench
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+corpus = bench.make_corpus()
+chunks, L = shard_text(corpus, 94, pad_multiple=512)
+
+t0 = time.time()
+dev = jax.device_put(chunks, sh)
+jax.block_until_ready(dev)
+print(f"put claims ready in {time.time()-t0:.2f}s", flush=True)
+
+f = jax.jit(lambda x: x.astype(jnp.int32).sum())
+# warm compile on tiny data to exclude compile from timing
+_ = np.asarray(f(jnp.ones((2, 8), jnp.uint8)))
+t0 = time.time()
+s = int(np.asarray(f(dev)))
+print(f"consuming compute (sum) took {time.time()-t0:.2f}s -> {s}", flush=True)
+t0 = time.time()
+s = int(np.asarray(f(dev)))
+print(f"second consume {time.time()-t0:.2f}s", flush=True)

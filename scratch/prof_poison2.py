@@ -1,0 +1,45 @@
+"""Does the degraded-transfer regime recover in-process? Is it deletion-
+driven? Sequence: put / engine / puts with sleeps / puts holding buffers."""
+import sys, time, gc
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+corpus = bench.make_corpus()
+chunks, L = shard_text(corpus, 94, pad_multiple=512)
+
+def put(tag, hold=[]):
+    t0 = time.time()
+    out = jax.device_put(chunks, sh)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    print(f"{tag:34s} {dt:6.2f}s {chunks.nbytes/1e6/dt:7.0f} MB/s", flush=True)
+    return out
+
+x = put("1 pre-engine put"); del x
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+eng = wc._engine_for(L)
+fn = eng._get_compiled(eng.config)
+dev = jax.device_put(chunks, sh)
+out = fn(dev, jax.device_put(np.arange(94, dtype=np.int32), sh), np.int32(94))
+jax.block_until_ready(out[4])
+print("engine ran", flush=True)
+
+# keep EVERYTHING alive (no deletions possible)
+x1 = put("2 post-engine put (outputs alive)")
+x2 = put("3 again (all alive)")
+del out, dev  # now release the engine buffers
+gc.collect()
+x3 = put("4 after deleting engine buffers")
+for i in range(4):
+    time.sleep(5)
+    x = put(f"5.{i} after {5*(i+1)}s sleep"); del x

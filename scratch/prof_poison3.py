@@ -1,0 +1,48 @@
+"""Which programs poison transfers? trivial / small-wave / big engine."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.ops.tokenize import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+mesh = make_mesh()
+sh = NamedSharding(mesh, P("data"))
+corpus = bench.make_corpus()
+chunks, L = shard_text(corpus, 94, pad_multiple=512)
+
+def put(tag):
+    t0 = time.time()
+    out = jax.device_put(chunks, sh)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    print(f"{tag:40s} {dt:6.2f}s {chunks.nbytes/1e6/dt:7.0f} MB/s", flush=True)
+    del out
+
+put("baseline put")
+
+# trivial program
+f = jax.jit(lambda x: (x.astype(jnp.int32) * 2).sum())
+r = f(jnp.ones((1024, 1024), jnp.uint8)); np.asarray(r)
+put("after trivial program")
+
+# medium: 1GB-workingset matmul
+g = jax.jit(lambda a, b: a @ b)
+a = jnp.ones((8192, 8192), jnp.bfloat16)
+r = g(a, a); jax.block_until_ready(r); del r, a
+put("after 8k matmul (~400MB ws)")
+
+# one WAVE of the engine (12 chunks, ~200MB records buffer)
+wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                     config=EngineConfig(local_capacity=1 << 18,
+                                         exchange_capacity=1 << 17,
+                                         out_capacity=1 << 18))
+eng = wc._engine_for(L)
+fn = eng._get_compiled(eng.config)
+dev = jax.device_put(chunks[:12], sh)
+out = fn(dev, jax.device_put(np.arange(12, dtype=np.int32), sh), np.int32(12))
+v = np.asarray(out[4]); del out, dev
+put("after ONE 12-chunk wave")
+put("again")
