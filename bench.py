@@ -74,11 +74,16 @@ def main() -> None:
     from mapreduce_tpu.parallel import make_mesh
 
     mesh = make_mesh()
+    # tile_records 104 vs the default 128: ~25% headroom over the ~83
+    # avg words per 512-byte tile of natural-ish text, and 0.4-0.8s less
+    # sort work than 128's 52%-empty record slots (scratch/prof_tune.py;
+    # overflow would only cost a retry, never correctness)
     wc = DeviceWordCount(
         mesh, chunk_len=1 << 22,
         config=EngineConfig(local_capacity=1 << 18,
                             exchange_capacity=1 << 17,
-                            out_capacity=1 << 18))
+                            out_capacity=1 << 18,
+                            tile=512, tile_records=104))
 
     n_runs = 1 if "--smoke" in sys.argv else 3
 

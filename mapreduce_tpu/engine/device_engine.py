@@ -440,5 +440,9 @@ class DeviceEngine:
                 timings["upload_s"] = round(t_upload, 3)
             timings["compute_s"] = round(t_compute, 3)
             timings["readback_s"] = round(t_readback, 3)
-            timings["total_s"] = round(time.time() - t_start, 3)
+            if staged is None:
+                # staged callers assemble their own run total (their
+                # upload happened elsewhere); an engine-local total here
+                # would contradict it
+                timings["total_s"] = round(time.time() - t_start, 3)
         return result

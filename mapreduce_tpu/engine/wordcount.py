@@ -167,15 +167,9 @@ class DeviceWordCount:
         t_split = time.time() - t0
         result = self._engine_for(L).run(chunks, timings=timings,
                                          waves=waves)
-        if result.overflow:
-            raise RuntimeError(
-                f"wordcount overflowed capacities by {result.overflow} "
-                "rows even after retries; raise EngineConfig capacities")
-        t0 = time.time()
-        out = materialize_counts(chunks, result)
+        out = self._finish(chunks, result, timings)
         if timings is not None:
             timings["split_s"] = round(t_split, 3)
-            timings["materialize_s"] = round(time.time() - t0, 3)
         return out
 
     def count_files(self, paths) -> Dict[bytes, int]:
@@ -197,11 +191,16 @@ class DeviceWordCount:
     def count_staged(self, handle,
                      timings: Optional[dict] = None) -> Dict[bytes, int]:
         """Count a corpus previously uploaded with :meth:`stage`."""
-        import time
-
         chunks, L, staged = handle
         result = self._engine_for(L).run(chunks, timings=timings,
                                          staged=staged)
+        return self._finish(chunks, result, timings)
+
+    def _finish(self, chunks, result,
+                timings: Optional[dict]) -> Dict[bytes, int]:
+        """Shared post-run tail: overflow check + host materialisation."""
+        import time
+
         if result.overflow:
             raise RuntimeError(
                 f"wordcount overflowed capacities by {result.overflow} "

@@ -1,0 +1,26 @@
+"""Sweep engine capacities at full scale: compute time vs overflow."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax
+import bench
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.parallel import make_mesh
+
+corpus = bench.make_corpus()
+mesh = make_mesh()
+
+for tr_ in (112, 104):
+    wc = DeviceWordCount(mesh, chunk_len=1 << 22,
+                         config=EngineConfig(local_capacity=1 << 18,
+                                             exchange_capacity=1 << 17,
+                                             out_capacity=1 << 18,
+                                             tile=512, tile_records=tr_))
+    handle = wc.stage(corpus)
+    tm = {}
+    t0 = time.time()
+    counts = wc.count_staged(handle, timings=tm)
+    ok = sum(counts.values()) == 49158635
+    print(f"tile_records={tr_}: wall {time.time()-t0:6.2f}s ok={ok} "
+          f"compute={tm.get('compute_s')}s waves={tm.get('waves')}",
+          flush=True)
+    del handle, wc
