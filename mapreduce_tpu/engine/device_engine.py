@@ -31,7 +31,9 @@ is a SORT HIERARCHY, the profile-driven round-2 redesign:
      UNIQUE records only; a final small sorted-unique pass per partition.
 
 All capacities are static; overflows are *counted* and surfaced, and
-:meth:`DeviceEngine.run` retries with doubled capacities until clean —
+:meth:`DeviceEngine.run` retries with capacities RIGHT-SIZED from the
+failed run's measured needs (per-stage unique counts ride out of the
+program; tile_records doubles only when the map stage itself dropped) —
 never a silent truncation.
 """
 
@@ -62,13 +64,6 @@ class EngineConfig:
     tile_records: int = 128           # record slots per tile (map side)
     reduce_op: Union[str, Callable] = "sum"
     unit_values: bool = False         # values are all 1: count runs instead
-
-    def doubled(self) -> "EngineConfig":
-        return replace(self,
-                       local_capacity=self.local_capacity * 2,
-                       exchange_capacity=self.exchange_capacity * 2,
-                       out_capacity=self.out_capacity * 2,
-                       tile_records=min(self.tile_records * 2, self.tile))
 
     def cache_key(self):
         # the op object itself is part of the key: keeping it in the
@@ -184,17 +179,26 @@ class DeviceEngine:
             # LOCAL overflow per device — the host sums across devices
             # (a psum here would get double-counted by that host sum)
             local_oflow = local_oflow + ex.overflow + fin_oflow
+            # capacity NEEDS per device, so a retry can jump straight to
+            # right-sized capacities instead of blind doubling (each lane
+            # is a lower bound if an earlier stage truncated, so the
+            # retry loop still iterates — but converges in one or two
+            # right-sized compiles):
+            # [local uniques, exchange per-dest max, final uniques,
+            #  map-stage drops]
+            needs = jnp.stack([local.n_unique, ex.max_count,
+                               fin.n_unique, map_oflow])
             # keep leading device axis for the host: [1, ...] per shard
             expand = lambda a: a[None]
             return (expand(fin.keys), expand(fin.values),
                     expand(fin.payload), expand(fin.valid),
-                    expand(local_oflow))
+                    expand(local_oflow), expand(needs))
 
         sharded = P(AXIS)
         fn = jax.shard_map(
             per_device, mesh=self.mesh,
             in_specs=(sharded, sharded, P()),
-            out_specs=(sharded, sharded, sharded, sharded, sharded),
+            out_specs=(sharded,) * 6,
         )
         return jax.jit(fn)
 
@@ -320,6 +324,39 @@ class DeviceEngine:
             pool.shutdown(wait=False)
         return wave_list, np.int32(S)
 
+    @staticmethod
+    def _fit(need: int) -> int:
+        """Round a measured need up to a power of two with ~25% margin."""
+        need = int(need * 1.25) + 16
+        return 1 << max(need - 1, 1).bit_length()
+
+    def _resize(self, cfg: EngineConfig, outs) -> EngineConfig:
+        """Right-size capacities from the failed run's measured needs
+        (program output lane 5: [local uniques, exchange per-dest max,
+        final uniques, map drops] per device) — one informed recompile
+        instead of blind doubling (SURVEY §7(a) count-then-size, done as
+        measure-then-size on the run we already paid for).  Needs are
+        lower bounds when an earlier stage truncated, so the loop may
+        take a second sizing pass; it never regresses a capacity."""
+        hosted = self._host(*[o[5] for o in outs])  # one batched gather
+        needs = np.stack(hosted if len(outs) > 1 else [hosted])
+        # [W, dev, 4]
+        local_need = int(needs[:, :, 0].max())
+        ex_need = int(needs[:, :, 1].max())
+        # per-partition union across waves is bounded by the sum of the
+        # waves' unique counts
+        fin_need = int(needs[:, :, 2].sum(axis=0).max())
+        map_dropped = int(needs[:, :, 3].sum())
+        return replace(
+            cfg,
+            local_capacity=max(cfg.local_capacity, self._fit(local_need)),
+            exchange_capacity=max(cfg.exchange_capacity,
+                                  self._fit(ex_need)),
+            out_capacity=max(cfg.out_capacity, self._fit(fin_need)),
+            tile_records=(min(cfg.tile_records * 2, cfg.tile)
+                          if map_dropped else cfg.tile_records),
+        )
+
     def stage_inputs(self, chunks: np.ndarray, waves: int = None):
         """Issue and COMPLETE the host->device transfer of *chunks*,
         returning an opaque staged handle for :meth:`run`.
@@ -388,7 +425,8 @@ class DeviceEngine:
 
         t_upload = None  # measured once: retries reuse resident inputs
         t_compute = 0.0
-        for _ in range(max_retries + 1):
+        retries = 0
+        for attempt in range(max_retries + 1):
             fn = self._get_compiled(cfg)
             t0 = time.time()
             # dispatch each wave once its input is RESIDENT: wave w's
@@ -409,7 +447,7 @@ class DeviceEngine:
                     cat(0), cat(1), cat(2), cat(3))
                 oflows.append(m_oflow)
             else:
-                keys, vals, pay, valid, _ = outs[0]
+                keys, vals, pay, valid = outs[0][:4]
             jax.block_until_ready([ci for ci, _ in resolved.values()])
             if t_upload is None:
                 # from t_start: includes _shard_inputs' staging copies
@@ -420,9 +458,11 @@ class DeviceEngine:
             # the (tiny) overflow readbacks force program completion
             total_oflow = sum(int(self._host(o).sum()) for o in oflows)
             t_compute += time.time() - compute_from
-            if total_oflow == 0:
-                break
-            cfg = cfg.doubled()
+            if total_oflow == 0 or attempt == max_retries:
+                break  # done, or out of retries (don't size a cfg that
+                # will never run)
+            retries = attempt + 1
+            cfg = self._resize(cfg, outs)
         del wave_inputs, resolved, outs
         # sliced readback: only the live prefix of each partition's
         # capacity-padded result crosses the (slow) device->host link
@@ -436,6 +476,7 @@ class DeviceEngine:
         t_readback = time.time() - t0
         if timings is not None:
             timings["waves"] = W
+            timings["retries"] = retries
             if staged is None:  # staged callers timed the upload already
                 timings["upload_s"] = round(t_upload, 3)
             timings["compute_s"] = round(t_compute, 3)

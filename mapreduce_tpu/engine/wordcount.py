@@ -39,8 +39,8 @@ def _wordcount_map_fn(chunk, chunk_index, cfg: EngineConfig):
 
     Tile compaction (one-hot matmul, no scatter) packs the per-byte
     token stream into at most ``L // cfg.tile * cfg.tile_records``
-    records; drops are counted and the engine retries with doubled
-    tile_records."""
+    records; drops are counted and the engine retries with tile_records
+    grown to fit (DeviceEngine._resize)."""
     import jax.numpy as jnp
 
     L = chunk.shape[0]
@@ -96,8 +96,9 @@ class DeviceWordCount:
     """Count words of a text corpus on a TPU mesh.
 
     ``chunk_len`` is the static per-chunk byte length; capacities default
-    to values sized for natural-language vocabularies and are doubled
-    automatically on overflow (DeviceEngine.run).
+    to values sized for natural-language vocabularies and are grown
+    automatically on overflow, right-sized from the failed run's
+    measured needs (DeviceEngine.run/_resize).
 
     ``verify_collisions=True`` detects 64-bit hash-key collisions (two
     distinct words merged on device; odds ~3e-8 at a 1M vocabulary) by

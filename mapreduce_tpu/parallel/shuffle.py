@@ -29,6 +29,8 @@ class Exchanged(NamedTuple):
     payload: jax.Array   # [P*C, Q] int32
     valid: jax.Array     # [P*C] bool
     overflow: jax.Array  # [] int32 — rows dropped on the SEND side here
+    max_count: jax.Array  # [] int32 — largest per-destination row count
+    #                       BEFORE capping (what capacity SHOULD have been)
 
 
 def partition_exchange(keys: jax.Array, values: jax.Array,
@@ -78,4 +80,5 @@ def partition_exchange(keys: jax.Array, values: jax.Array,
         payload=flat(recv_pay),
         valid=flat(recv_live) == 1,
         overflow=overflow,
+        max_count=counts.max().astype(jnp.int32),
     )

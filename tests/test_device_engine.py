@@ -163,14 +163,20 @@ def test_device_wordcount_equals_oracle(wc_mesh):
 
 
 def test_device_wordcount_overflow_retry(wc_mesh):
-    """Tiny capacities must be doubled automatically, not silently drop."""
+    """Tiny capacities must be grown automatically, not silently drop —
+    and the retry right-sizes from the failed run's measured needs, so
+    even absurdly small starting capacities converge in at most two
+    sizing passes (the second only when an earlier stage's truncation
+    understated a later stage's need)."""
     data = _random_text(n_words=2000, seed=2)
     wc = DeviceWordCount(
         wc_mesh, chunk_len=2048,
-        config=EngineConfig(local_capacity=32, exchange_capacity=8,
-                            out_capacity=32))
-    got = wc.count_bytes(data)
+        config=EngineConfig(local_capacity=4, exchange_capacity=2,
+                            out_capacity=4))
+    tm = {}
+    got = wc.count_bytes(data, timings=tm)
     assert got == _oracle(data)
+    assert 1 <= tm["retries"] <= 2, tm
 
 
 def test_device_wordcount_empty(wc_mesh):
