@@ -57,11 +57,30 @@ class TransformerConfig:
     #: single-chip long-context blocker once attention is chunked.
     #: None = unchunked; must divide T_local
     loss_block: Any = None
+    #: EXPERT parallelism (Switch-style top-1 MoE FFN): each model-axis
+    #: rank hosts ONE expert whose hidden width is ffn/n_model — the
+    #: exact parameter shapes and shardings of the dense TP layer, used
+    #: as disjoint experts instead of column shards (so moe_experts must
+    #: equal the mesh's model-axis size).  Tokens are routed by a
+    #: learned router, capacity-gathered per expert (compute per rank is
+    #: O(capacity), not O(tokens)), and gate-weighted back with one
+    #: psum.  Over-capacity tokens fall through on the residual.
+    #: 0 = dense FFN.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    #: weight of the Switch auxiliary load-balance loss — without it the
+    #: gate gradient is rich-get-richer (the winning expert's logit only
+    #: grows) and routing collapses onto one expert
+    moe_aux_weight: float = 0.01
 
     def validate(self, n_model: int) -> None:
         assert self.n_heads % n_model == 0, "heads must split over model axis"
         assert self.ffn % n_model == 0
         assert self.vocab % n_model == 0
+        if self.moe_experts:
+            assert self.moe_experts == n_model, (
+                "expert parallelism maps one expert per model-axis rank: "
+                f"moe_experts={self.moe_experts} != n_model={n_model}")
 
 
 def init_transformer(key: jax.Array, cfg: TransformerConfig) -> Params:
@@ -84,12 +103,17 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> Params:
         params[f"L{i}.wo"] = norm(keys[k0 + 1], (H * D, E), H * D)
         params[f"L{i}.w_in"] = norm(keys[k0 + 2], (E, F), E)
         params[f"L{i}.w_out"] = norm(keys[k0 + 3], (F, E), F)
+        if cfg.moe_experts:
+            params[f"L{i}.w_router"] = norm(keys[k0 + 4],
+                                            (E, cfg.moe_experts), E)
     return params
 
 
 def transformer_param_spec(name: str) -> P:
     """Tensor-parallel placement by name: head/column-sharded projections,
-    row-sharded outputs, replicated norms/embeddings."""
+    row-sharded outputs, replicated norms/embeddings/router.  The same
+    w_in/w_out shards double as per-rank EXPERTS under expert parallelism
+    (moe_experts) — the layout is identical, only the math changes."""
     if name.endswith((".wqkv", ".w_in")):
         return P(None, None, "model") if name.endswith("wqkv") \
             else P(None, "model")
@@ -126,19 +150,73 @@ def _layer_local(x: jax.Array, lp: Params, cfg: TransformerConfig,
     x = x + o.astype(cfg.dtype)
 
     h = _rmsnorm(x, lp["ln2_scale"].astype(cfg.dtype))
-    u = jnp.einsum("bte,ef->btf", h, lp["w_in"].astype(cfg.dtype))
+    if cfg.moe_experts:
+        m, aux = _moe_ffn(h, lp, cfg, model_axis)
+    else:
+        u = jnp.einsum("bte,ef->btf", h, lp["w_in"].astype(cfg.dtype))
+        u = jax.nn.gelu(u)
+        m = jnp.einsum("btf,fe->bte", u, lp["w_out"].astype(cfg.dtype))
+        m = jax.lax.psum(m.astype(jnp.float32), model_axis)
+        aux = jnp.float32(0.0)
+    return x + m.astype(cfg.dtype), aux
+
+
+def _moe_ffn(h: jax.Array, lp: Params, cfg: TransformerConfig,
+             model_axis: str) -> jax.Array:
+    """Switch-style top-1 expert-parallel FFN (one expert per model-axis
+    rank).  Activations are replicated over the model axis (the TP
+    invariant), so routing needs NO token exchange: each rank
+    capacity-gathers the tokens its expert owns, runs its [E, ffn/n]
+    expert on just those, scatters back, gate-weights, and ONE psum
+    assembles the disjoint expert outputs — same collective count as the
+    dense TP layer.  Tokens beyond capacity fall through on the residual
+    (standard Switch behavior; the router's load-balance pressure comes
+    from the gate gradient)."""
+    B, T, E = h.shape
+    N = B * T
+    n_exp = cfg.moe_experts
+    cap = max(1, int(N * cfg.moe_capacity_factor / n_exp))
+    rank = jax.lax.axis_index(model_axis)
+
+    flat = h.reshape(N, E)
+    r_logits = jnp.einsum("ne,ex->nx", flat.astype(jnp.float32),
+                          lp["w_router"])  # [N, n_exp]
+    probs = jax.nn.softmax(r_logits, axis=-1)
+    expert = jnp.argmax(r_logits, axis=-1)          # [N]
+    gate = probs[jnp.arange(N), expert]             # [N] chosen-expert prob
+
+    mine = expert == rank
+    order = jnp.argsort(~mine)                      # my tokens first (stable)
+    take = order[:cap]                              # indices into flat
+    took_mine = mine[take]                          # padding rows masked
+    u = jnp.einsum("ce,ef->cf", flat[take], lp["w_in"].astype(cfg.dtype))
     u = jax.nn.gelu(u)
-    m = jnp.einsum("btf,fe->bte", u, lp["w_out"].astype(cfg.dtype))
-    m = jax.lax.psum(m.astype(jnp.float32), model_axis)
-    return x + m.astype(cfg.dtype)
+    y = jnp.einsum("cf,fe->ce", u, lp["w_out"].astype(cfg.dtype))
+    # gate-weight the [cap, E] expert rows BEFORE the scatter (the
+    # router's gradient path); foreign/padding rows zero out
+    y = y.astype(jnp.float32) * (gate[take] * took_mine)[:, None]
+    out = jnp.zeros((N, E), jnp.float32).at[take].add(y)
+    out = jax.lax.psum(out, model_axis)             # disjoint expert sums
+
+    # Switch auxiliary load-balance loss: n * sum_e(frac_e * meanP_e),
+    # minimised (=1) at uniform routing; reported as the excess over 1 so
+    # a single expert contributes exactly 0.  f is argmax-based (no
+    # gradient); the pressure reaches the router through meanP.
+    # Activations are replicated over the model axis, so every rank
+    # computes the identical value — no collective.
+    f = jnp.mean(jax.nn.one_hot(expert, n_exp, dtype=jnp.float32), axis=0)
+    mean_p = probs.mean(axis=0)
+    aux = jnp.float32(n_exp) * jnp.dot(f, mean_p) - 1.0
+    return out.reshape(B, T, E).astype(cfg.dtype), aux
 
 
 def forward_local(params: Params, tokens: jax.Array,
                   cfg: TransformerConfig, n_model: int,
                   data_axis: str = "data", model_axis: str = "model"):
     """Local-block forward INSIDE shard_map: ``tokens`` [B, T_local]
-    int32; returns hidden states [B, T_local, E] (f32).  Params arrive
-    already sliced by transformer_param_spec."""
+    int32; returns ``(hidden [B, T_local, E] f32, aux [] f32)`` where aux
+    is the summed MoE load-balance excess (0 for dense layers).  Params
+    arrive already sliced by transformer_param_spec."""
     x = params["embed"][tokens].astype(cfg.dtype)  # [B, T, E]
 
     def layer(x, lp):
@@ -146,12 +224,14 @@ def forward_local(params: Params, tokens: jax.Array,
 
     if cfg.remat:
         layer = jax.checkpoint(layer)
+    aux_total = jnp.float32(0.0)
     for i in range(cfg.n_layers):
         prefix = f"L{i}."
         lp = {k[len(prefix):]: v for k, v in params.items()
               if k.startswith(prefix)}
-        x = layer(x, lp)
-    return x.astype(jnp.float32)
+        x, aux = layer(x, lp)
+        aux_total = aux_total + aux
+    return x.astype(jnp.float32), aux_total
 
 
 def loss_local(params: Params, tokens: jax.Array, targets: jax.Array,
@@ -162,7 +242,8 @@ def loss_local(params: Params, tokens: jax.Array, targets: jax.Array,
     pmax/psum over the model axis; the mean combines with pmean over the
     sequence (data) axis.  ``targets`` are the GLOBAL next tokens for this
     block (host pre-shifts across shard boundaries)."""
-    x = forward_local(params, tokens, cfg, n_model, data_axis, model_axis)
+    x, aux = forward_local(params, tokens, cfg, n_model, data_axis,
+                           model_axis)
     w = params["unembed"]  # [E, V_loc]
 
     def chunk_nll(x_c, t_c):
@@ -208,7 +289,8 @@ def loss_local(params: Params, tokens: jax.Array, targets: jax.Array,
             lambda _, xt: (None, chunk_nll(*xt)))
         _, nll_chunks = jax.lax.scan(body, None, (xs, ts))
         nll = jnp.moveaxis(nll_chunks, 0, 1).reshape(B, T)
-    return jax.lax.pmean(nll.mean(), data_axis)
+    total = nll.mean() + jnp.float32(cfg.moe_aux_weight) * aux
+    return jax.lax.pmean(total, data_axis)
 
 
 class TransformerTrainer:

@@ -19,7 +19,7 @@ Rows never leave their tile (output is [n_tiles, K] with per-tile
 validity) — global packing is deliberately skipped because the engine
 sorts all records immediately afterwards, and a sort does not care about
 padding order.  Records past K per tile are dropped but COUNTED
-(``overflow``), and the engine retries with doubled K (SURVEY.md §7(a)).
+(``overflow``), and the engine retries with K grown to fit (DeviceEngine._resize; SURVEY.md §7(a)).
 """
 
 from __future__ import annotations
