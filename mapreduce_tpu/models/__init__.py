@@ -25,3 +25,4 @@ examples/train_digits/, proving the user contract covers iterative SGD.
 from .mlp import MLPConfig, init_params, forward, loss_and_accuracy  # noqa: F401
 from .digits import make_digits  # noqa: F401
 from .trainer import TrainConfig, DistributedTrainer  # noqa: F401
+from .pipeline import PipelineConfig, PipelinedTrainer  # noqa: F401

@@ -162,7 +162,7 @@ def _layer_local(x: jax.Array, lp: Params, cfg: TransformerConfig,
 
 
 def _moe_ffn(h: jax.Array, lp: Params, cfg: TransformerConfig,
-             model_axis: str) -> jax.Array:
+             model_axis: str) -> Tuple[jax.Array, jax.Array]:
     """Switch-style top-1 expert-parallel FFN (one expert per model-axis
     rank).  Activations are replicated over the model axis (the TP
     invariant), so routing needs NO token exchange: each rank
@@ -199,8 +199,10 @@ def _moe_ffn(h: jax.Array, lp: Params, cfg: TransformerConfig,
     out = jax.lax.psum(out, model_axis)             # disjoint expert sums
 
     # Switch auxiliary load-balance loss: n * sum_e(frac_e * meanP_e),
-    # minimised (=1) at uniform routing; reported as the excess over 1 so
-    # a single expert contributes exactly 0.  f is argmax-based (no
+    # equal to 1 at uniform routing and reported relative to 1 so a
+    # single expert contributes exactly 0.  (Mildly negative values are
+    # possible when argmax picks anti-correlate with mean probs — a
+    # constant shift, gradients unaffected.)  f is argmax-based (no
     # gradient); the pressure reaches the router through meanP.
     # Activations are replicated over the model axis, so every rank
     # computes the identical value — no collective.
