@@ -112,10 +112,38 @@ def bench_mlp(mesh, platform):
     return out
 
 
-def bench_transformer(mesh, platform):
+def _transformer_rate(mesh, cfg, B, T, n_steps=None):
+    """Shared harness: one trainer, timed steps; returns (sec/step,
+    n_params)."""
     import jax
-    from mapreduce_tpu.models.transformer import (
-        TransformerConfig, TransformerTrainer)
+    from mapreduce_tpu.models.transformer import TransformerTrainer
+
+    tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+    params = tr.init_params()
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab, size=(B, T + 1)).astype(np.int32)
+    x, y = tr.place_batch(toks)
+    state = {"params": params}
+
+    def step():
+        state["params"], loss = tr._train_step(state["params"], x, y)
+        return loss
+
+    sec = _timeit(step, n=n_steps)
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree.leaves(state["params"]))
+    return sec, n_params
+
+
+def _train_flops(cfg, n_params, B, T):
+    """6ND for the dense matmuls + attention: fwd QK^T and AV are
+    2*B*H*T^2*D FLOPs each; x3 for training."""
+    attn = 3 * 2 * 2 * B * cfg.n_heads * T * T * cfg.head_dim
+    return 6.0 * n_params * (B * T) + attn
+
+
+def bench_transformer(mesh, platform):
+    from mapreduce_tpu.models.transformer import TransformerConfig
 
     n_data = mesh.shape["data"]
     cfg = TransformerConfig(
@@ -123,27 +151,9 @@ def bench_transformer(mesh, platform):
         n_heads=16, head_dim=64, ffn=4096)
     B = 4
     T = 2048 * n_data  # sequence-parallel: T/n_data per device
-    tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
-    params = tr.init_params()
-    rng = np.random.default_rng(0)
-    toks = rng.integers(0, cfg.vocab, size=(B, T + 1)).astype(np.int32)
-    x, y = tr.place_batch(toks)
-
-    state = {"params": params}
-
-    def step():
-        state["params"], loss = tr._train_step(state["params"], x, y)
-        return loss
-
-    sec = _timeit(step)
-    n_params = sum(int(np.prod(np.shape(p)))
-                   for p in jax.tree.leaves(state["params"]))
+    sec, n_params = _transformer_rate(mesh, cfg, B, T)
     tokens = B * T
-    # 6ND for the dense matmuls + attention: fwd QK^T and AV are
-    # 2*B*H*T^2*D FLOPs each; x3 for training
-    H, D = cfg.n_heads, cfg.head_dim
-    attn_flops = 3 * 2 * 2 * B * H * T * T * D
-    flops = 6.0 * n_params * tokens + attn_flops
+    flops = _train_flops(cfg, n_params, B, T)
     n_chips = len(mesh.devices.flat)
     peak = PEAK_FLOPS.get(platform)
     out = {
@@ -155,6 +165,33 @@ def bench_transformer(mesh, platform):
         "global_batch": B,
         "params_m": round(n_params / 1e6, 1),
         "flops_per_step": flops,
+    }
+    if peak:
+        out["mfu"] = round(flops / sec / (peak * n_chips), 4)
+    return out
+
+
+def bench_longctx(mesh, platform):
+    """A fixed 32,768-token context SHARDED over the mesh (remat + flash
+    QxKV attention tiling + sequence-chunked loss; README's long-context
+    story as a runnable number — same context length whatever the mesh,
+    so the metric compares across machines)."""
+    from mapreduce_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab=32768, embed=1024, n_layers=8, n_heads=16, head_dim=64,
+        ffn=4096, remat=True, attn_block=1024, loss_block=2048)
+    T = 32768
+    sec, n_params = _transformer_rate(mesh, cfg, 1, T, n_steps=3)
+    flops = _train_flops(cfg, n_params, 1, T)
+    n_chips = len(mesh.devices.flat)
+    peak = PEAK_FLOPS.get(platform)
+    out = {
+        "metric": "transformer_32k_ctx_tokens_per_s",
+        "value": round(T / sec, 1),
+        "unit": "tok/s",
+        "seq_len": T,
+        "steps_per_s": round(1.0 / sec, 3),
     }
     if peak:
         out["mfu"] = round(flops / sec / (peak * n_chips), 4)
@@ -183,6 +220,9 @@ def main() -> None:
     print(json.dumps(bench_mlp(mesh, platform)), flush=True)
     print("# transformer ...", file=sys.stderr, flush=True)
     print(json.dumps(bench_transformer(mesh, platform)), flush=True)
+    if not smoke and platform == "tpu":
+        print("# 32k context ...", file=sys.stderr, flush=True)
+        print(json.dumps(bench_longctx(mesh, platform)), flush=True)
 
 
 if __name__ == "__main__":
