@@ -12,9 +12,10 @@ strips ``.lua``):
   python -m mapreduce_tpu.cli wordcount FILES... [--device] — convenience
       wrapper over the WordCount example / device engine.
 
-CONNSTR is ``mem://NAME`` (single process) or ``dir:///PATH`` (shared
-directory: start workers as separate OS processes pointing at the same
-path — the reference's N-processes-one-mongod topology, test.sh:10).
+CONNSTR is ``mem://NAME`` (single process), ``dir:///PATH`` (shared
+directory: OS processes on one host / NFS), or ``http://HOST:PORT``
+(a ``docserver`` — any worker on any machine joins over TCP, the
+reference's N-processes-one-mongod topology, test.sh:10 + cnn.lua:34-39).
 """
 
 from __future__ import annotations
@@ -190,6 +191,35 @@ def cmd_blobserver(argv: List[str]) -> int:
     return 0
 
 
+def cmd_docserver(argv: List[str]) -> int:
+    """Serve the control plane (job board) over HTTP — the mongod role.
+    Workers and servers on any machine connect with ``http://HOST:PORT``
+    as their CONNSTR; pass --root to back the board with a durable
+    dir:// store that survives docserver restarts."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu docserver")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8751)
+    p.add_argument("--root", default=None,
+                   help="back the board with dir://ROOT (durable) "
+                        "instead of in-memory")
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+
+    from .coord.docserver import DocServer
+    from .coord.docstore import DirDocStore
+
+    store = DirDocStore(args.root) if args.root else None
+    srv = DocServer(store, args.host, args.port)
+    print(f"job board at http://{srv.host}:{srv.port} "
+          f"(CONNSTR: \"http://HOST:{srv.port}\")", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_drop(argv: List[str]) -> int:
     """Drop a task's control-plane collections and (optionally) its
     storage blobs — the reference's remove_results.sh (db.dropDatabase())."""
@@ -223,7 +253,7 @@ def cmd_drop(argv: List[str]) -> int:
 
 COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "wordcount": cmd_wordcount, "drop": cmd_drop,
-            "blobserver": cmd_blobserver}
+            "blobserver": cmd_blobserver, "docserver": cmd_docserver}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -12,6 +12,7 @@ are reaped (reference has neither, task.lua:294-309 FIXMEs, SURVEY.md §5).
 """
 
 from .docstore import MemoryDocStore, DirDocStore, connect  # noqa: F401
+from .docserver import DocServer, HttpDocStore  # noqa: F401
 from .connection import Connection  # noqa: F401
 from .task import Task  # noqa: F401
 from .job import Job  # noqa: F401

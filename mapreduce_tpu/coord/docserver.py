@@ -1,0 +1,274 @@
+"""Networked control plane: the docstore served over HTTP.
+
+The reference's whole deployment story is "point any worker on any
+machine at one connstr" — mongod is reachable over TCP
+(/root/reference/mapreduce/cnn.lua:34-39, worker.lua:20-27).  The
+rebuild's ``mem://`` and ``dir://`` backends cover one process and one
+filesystem; this module covers the network: a :class:`DocServer` owns a
+single authoritative :class:`~.docstore.MemoryDocStore` and speaks a tiny
+JSON-RPC over HTTP, and :class:`HttpDocStore` is the client-side
+:class:`~.docstore.DocStore` behind the ``http://HOST:PORT`` connstr.
+Any worker on any machine can now claim jobs with zero shared
+filesystem — the same topology as N workers dialing one mongod.
+
+Atomicity: every RPC executes under the backing store's lock on the
+server, so ``find_and_modify`` claims and ``$inc`` retries keep exactly
+the single-document atomicity the in-process backends give
+(task.lua:294-309's racy claim emulation is still genuinely atomic here).
+
+Retry safety: a broken socket mid-request leaves the client unsure
+whether the server applied the op.  Mutating RPCs therefore carry a
+client-generated request id; the server remembers recently answered ids
+and replays the recorded response instead of re-applying — exactly-once
+across one reconnect, so a retried claim cannot double-claim and a
+retried ``$inc`` cannot double-count (the double-apply hazard the blob
+client tolerates only because blob PUTs are idempotent whole-content
+writes, httpstore.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import http.server
+import json
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.httpclient import KeepAliveClient
+from .docstore import Doc, DocStore, MemoryDocStore, Query
+
+# ops whose second application would change state: answered once, replayed
+# from the dedupe cache on retry.  Reads re-execute harmlessly.
+_MUTATING_OPS = frozenset(
+    {"insert", "insert_many", "update", "find_and_modify", "remove",
+     "drop_collection"})
+
+_DEDUPE_CAP = 4096  # answered-request ids remembered per server
+
+
+class _RpcHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: DocStore            # set by DocServer
+    done: "collections.OrderedDict[str, bytes]"   # rid -> recorded response
+    inflight: Dict[str, threading.Event]          # rid -> original executing
+    dedupe_lock: threading.Lock
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _respond(self, code: int, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:
+        if self.path != "/rpc":
+            return self._respond(404, b"{}")
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length))
+            op = req["op"]
+        except (json.JSONDecodeError, KeyError, UnicodeDecodeError,
+                TypeError):  # TypeError: valid JSON that isn't an object
+            return self._respond(400, b"{}")
+
+        rid = req.get("rid") if op in _MUTATING_OPS else None
+        if rid is not None:
+            # a retry can arrive while the original is STILL executing (the
+            # client only retries after its socket broke, but the server
+            # thread serving the broken socket may not have finished):
+            # reserve the rid before executing so the duplicate waits for
+            # the recorded response instead of re-applying
+            with self.dedupe_lock:
+                replay = self.done.get(rid)
+                waiter = None if replay is not None else self.inflight.get(rid)
+                if replay is None and waiter is None:
+                    self.inflight[rid] = threading.Event()
+            if replay is not None:
+                return self._respond(200, replay)
+            if waiter is not None:
+                waiter.wait(timeout=60)
+                with self.dedupe_lock:
+                    replay = self.done.get(rid)
+                if replay is None:  # original died without recording
+                    replay = json.dumps(
+                        {"ok": False, "type": "IOError",
+                         "error": "retried rpc: original did not complete"}
+                    ).encode()
+                return self._respond(200, replay)
+
+        body = None
+        try:
+            result = self._execute(op, req)
+            body = json.dumps({"ok": True, "result": result}).encode()
+        except Exception as exc:
+            # catch EVERYTHING: a reserved rid must always get a recorded
+            # response, or the client's reconnect-retry would re-execute a
+            # mutation whose first attempt partially applied (e.g. ENOSPC
+            # mid-multi-update on a dir:// board)
+            body = json.dumps({"ok": False, "type": type(exc).__name__,
+                               "error": str(exc)}).encode()
+        finally:
+            if rid is not None:
+                with self.dedupe_lock:
+                    ev = self.inflight.pop(rid, None)
+                    if body is not None:  # BaseException: leave unrecorded
+                        self.done[rid] = body
+                        while len(self.done) > _DEDUPE_CAP:
+                            self.done.popitem(last=False)
+                if ev is not None:
+                    ev.set()
+        self._respond(200, body)
+
+    def _execute(self, op: str, req: Dict[str, Any]) -> Any:
+        store = self.store
+        coll = req.get("coll")
+        if op == "insert":
+            return store.insert(coll, req["doc"])
+        if op == "insert_many":
+            return store.insert_many(coll, req["docs"])
+        if op == "find":
+            return store.find(coll, req.get("query"))
+        if op == "count":
+            return store.count(coll, req.get("query"))
+        if op == "update":
+            return store.update(coll, req["query"], req["update"],
+                                multi=bool(req.get("multi")),
+                                upsert=bool(req.get("upsert")))
+        if op == "find_and_modify":
+            return store.find_and_modify(coll, req["query"], req["update"])
+        if op == "remove":
+            return store.remove(coll, req.get("query"))
+        if op == "drop_collection":
+            store.drop_collection(coll)
+            return None
+        if op == "collections":
+            return store.collections()
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown rpc op {op!r}")
+
+
+class DocServer:
+    """Serve a DocStore over HTTP (threaded, stdlib) — the mongod role.
+
+    Wraps a :class:`MemoryDocStore` by default (authoritative state lives
+    in this process; its RLock makes each RPC atomic); pass a
+    ``DirDocStore`` to make the board durable across server restarts the
+    way mongod's disk was.
+    """
+
+    def __init__(self, store: Optional[DocStore] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        handler = type("BoundRpcHandler", (_RpcHandler,), {
+            "store": store if store is not None else MemoryDocStore(),
+            "done": collections.OrderedDict(),
+            "inflight": {},
+            "dedupe_lock": threading.Lock(),
+        })
+        self.store = handler.store
+        self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def connstr(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start_background(self) -> "DocServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.httpd.server_close()
+
+
+class HttpDocStore(DocStore):
+    """Client DocStore over a :class:`DocServer` (``http://HOST:PORT``).
+
+    One keep-alive connection per handle, serialized by a lock (a worker's
+    claim loop and its heartbeat thread share the handle); re-established
+    once on a broken socket, with the request id making the retry
+    exactly-once for mutating ops.
+    """
+
+    def __init__(self, address: str) -> None:
+        host, _, port = address.partition(":")
+        if not port:
+            raise ValueError(
+                f"http docstore wants HOST:PORT, got {address!r}")
+        self.host, self.port = host, int(port)
+        self._client = KeepAliveClient(self.host, self.port)
+
+    def _rpc(self, op: str, **fields: Any) -> Any:
+        payload: Dict[str, Any] = {"op": op, **fields}
+        if op in _MUTATING_OPS:
+            payload["rid"] = uuid.uuid4().hex
+        body = json.dumps(payload).encode()
+        status, raw = self._client.request(
+            "POST", "/rpc", body=body,
+            headers={"Content-Type": "application/json"})
+        if status != 200:
+            raise IOError(f"docstore rpc {op!r}: HTTP {status}")
+        reply = json.loads(raw)
+        if not reply.get("ok"):
+            exc_type = {"ValueError": ValueError, "KeyError": KeyError,
+                        "TypeError": TypeError}.get(reply.get("type"),
+                                                    IOError)
+            raise exc_type(reply.get("error", "rpc failed"))
+        return reply["result"]
+
+    # -- DocStore interface ------------------------------------------------
+
+    def insert(self, coll: str, doc: Doc) -> str:
+        return self._rpc("insert", coll=coll, doc=doc)
+
+    def insert_many(self, coll: str, docs: List[Doc]) -> List[str]:
+        return self._rpc("insert_many", coll=coll, docs=docs)
+
+    def find(self, coll: str, query: Optional[Query] = None) -> List[Doc]:
+        return self._rpc("find", coll=coll, query=query)
+
+    def count(self, coll: str, query: Optional[Query] = None) -> int:
+        return self._rpc("count", coll=coll, query=query)
+
+    def update(self, coll: str, query: Query, update: Doc,
+               multi: bool = False, upsert: bool = False) -> int:
+        return self._rpc("update", coll=coll, query=query, update=update,
+                         multi=multi, upsert=upsert)
+
+    def find_and_modify(self, coll: str, query: Query, update: Doc,
+                        sort_key: Optional[Callable[[Doc], Any]] = None,
+                        ) -> Optional[Doc]:
+        if sort_key is not None:
+            # callables don't cross the wire; no framework caller passes one
+            raise NotImplementedError(
+                "HttpDocStore.find_and_modify does not support sort_key")
+        return self._rpc("find_and_modify", coll=coll, query=query,
+                         update=update)
+
+    def remove(self, coll: str, query: Optional[Query] = None) -> int:
+        return self._rpc("remove", coll=coll, query=query)
+
+    def drop_collection(self, coll: str) -> None:
+        self._rpc("drop_collection", coll=coll)
+
+    def collections(self) -> List[str]:
+        return self._rpc("collections")
+
+    def ping(self) -> bool:
+        return self._rpc("ping") == "pong"
+
+    def close(self) -> None:
+        self._client.close()

@@ -472,18 +472,25 @@ def connect(connstr: str) -> DocStore:
     """Open a store from a connection string (reference: a mongod host:port,
     utils.lua:62-69).  Forms:
 
-      * ``mem://<name>``  — process-local named MemoryDocStore
-      * ``dir:///path``   — DirDocStore rooted at /path
-      * ``/abs/path``     — shorthand for dir://
+      * ``mem://<name>``       — process-local named MemoryDocStore
+      * ``dir:///path``        — DirDocStore rooted at /path
+      * ``/abs/path``          — shorthand for dir://
+      * ``http://HOST:PORT``   — HttpDocStore dialing a DocServer (the
+        cross-host topology: any worker anywhere joins with one connstr,
+        like the reference's workers dialing one mongod)
     """
     if connstr.startswith("mem://"):
         return MemoryDocStore.named(connstr[len("mem://"):])
     if connstr.startswith("dir://"):
         return DirDocStore(connstr[len("dir://"):])
+    if connstr.startswith("http://"):
+        from .docserver import HttpDocStore
+        return HttpDocStore(connstr[len("http://"):])
     if connstr.startswith("/"):
         return DirDocStore(connstr)
     raise ValueError(
-        f"bad connection string {connstr!r} (want mem://NAME or dir:///PATH)")
+        f"bad connection string {connstr!r} "
+        "(want mem://NAME, dir:///PATH, or http://HOST:PORT)")
 
 
 def now() -> float:

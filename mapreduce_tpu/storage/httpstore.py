@@ -18,12 +18,12 @@ Plain stdlib on both sides; no ssh keys, no NFS mount.
 
 from __future__ import annotations
 
-import http.client
 import http.server
 import threading
 import urllib.parse
 from typing import Iterator, List, Optional, Tuple
 
+from ..utils.httpclient import KeepAliveClient
 from .base import Storage
 from .localdir import LocalDirStorage
 
@@ -127,28 +127,17 @@ class HttpStorage(Storage):
             raise ValueError(
                 f"http storage wants HOST:PORT, got {address!r}")
         self.host, self.port = host, int(port)
-        self._cnn: Optional[http.client.HTTPConnection] = None
-        self._lock = threading.Lock()
+        self._client = KeepAliveClient(self.host, self.port)
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None
                  ) -> Tuple[int, bytes]:
-        """One keep-alive connection per storage handle (the server speaks
-        HTTP/1.1), re-established once on a stale/broken socket."""
-        with self._lock:
-            for attempt in (0, 1):
-                if self._cnn is None:
-                    self._cnn = http.client.HTTPConnection(
-                        self.host, self.port, timeout=60)
-                try:
-                    self._cnn.request(method, path, body=body)
-                    r = self._cnn.getresponse()
-                    return r.status, r.read()
-                except (http.client.HTTPException, OSError):
-                    self._cnn.close()
-                    self._cnn = None
-                    if attempt:
-                        raise
-            raise AssertionError("unreachable")
+        """The KeepAliveClient retry is blind (the first attempt may have
+        been applied before the socket broke), which is safe ONLY because
+        every mutating blob endpoint is idempotent: PUT publishes whole
+        content atomically and DELETE converges.  A future non-idempotent
+        endpoint must not ride this path — give it request-id dedupe like
+        the docserver's mutating RPCs (coord/docserver.py)."""
+        return self._client.request(method, path, body=body)
 
     def _blob_path(self, name: str) -> str:
         return "/blobs/" + urllib.parse.quote(name, safe="")

@@ -1,0 +1,49 @@
+"""Shared keep-alive HTTP client for the two network planes.
+
+One persistent connection per handle (both services speak HTTP/1.1),
+serialized by a lock (a worker's claim loop and its heartbeat thread share
+one handle), re-established once on a stale/broken socket.  Used by the
+blob client (storage/httpstore.py) and the doc client (coord/docserver.py);
+whether the single blind retry is SAFE is the caller's contract — blob
+endpoints are idempotent, docstore mutations carry request-id dedupe.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class KeepAliveClient:
+    def __init__(self, host: str, port: int, timeout: float = 60.0) -> None:
+        self.host, self.port, self.timeout = host, port, timeout
+        self._cnn: Optional[http.client.HTTPConnection] = None
+        self._lock = threading.Lock()
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None,
+                ) -> Tuple[int, bytes]:
+        with self._lock:
+            for attempt in (0, 1):
+                if self._cnn is None:
+                    self._cnn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=self.timeout)
+                try:
+                    self._cnn.request(method, path, body=body,
+                                      headers=headers or {})
+                    r = self._cnn.getresponse()
+                    return r.status, r.read()
+                except (http.client.HTTPException, OSError):
+                    self._cnn.close()
+                    self._cnn = None
+                    if attempt:
+                        raise
+            raise AssertionError("unreachable")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._cnn is not None:
+                self._cnn.close()
+                self._cnn = None

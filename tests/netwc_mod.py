@@ -1,0 +1,53 @@
+"""WordCount variant whose inputs are blobs in the job's storage backend —
+used by test_multiprocess to prove the full zero-shared-filesystem
+topology: task claims over http:// (DocServer), input + intermediate +
+result bytes over http: (BlobServer).  Nothing but the two sockets."""
+
+from typing import Any, Dict, List
+
+_conf: Dict[str, Any] = {"blobs": [], "num_reducers": 5, "storage": None}
+RESULT: Dict[str, int] = {}
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+
+def init(args: Any) -> None:
+    if args:
+        _conf.update(args)
+
+
+def taskfn(emit) -> None:
+    for i, name in enumerate(_conf["blobs"]):
+        emit(i, name)
+
+
+def mapfn(key: Any, blobname: str, emit) -> None:
+    from mapreduce_tpu import storage
+
+    st = storage.router(_conf["storage"])
+    for line in st.open_lines(blobname):
+        for word in line.split():
+            emit(word, 1)
+
+
+def partitionfn(key: str) -> int:
+    from mapreduce_tpu.utils.hashing import fnv1a32
+
+    return fnv1a32(key.encode("utf-8")) % _conf["num_reducers"]
+
+
+def reducefn(key: str, values: List[int]) -> int:
+    return sum(values)
+
+
+def combinerfn(key: str, values: List[int]) -> int:
+    return sum(values)
+
+
+def finalfn(pairs) -> bool:
+    RESULT.clear()
+    for key, values in pairs:
+        RESULT[key] = values[0]
+    return True

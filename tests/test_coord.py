@@ -15,19 +15,26 @@ import pytest
 
 from mapreduce_tpu.coord import docstore
 from mapreduce_tpu.coord.connection import Connection
+from mapreduce_tpu.coord.docserver import DocServer
 from mapreduce_tpu.coord.persistent_table import PersistentTable
 from mapreduce_tpu.coord.task import Task, make_job
 from mapreduce_tpu.utils.constants import STATUS, TASK_STATUS
 
 
-@pytest.fixture(params=["mem", "dir"])
+@pytest.fixture(params=["mem", "dir", "http"])
 def store(request, tmp_path):
     if request.param == "mem":
         yield docstore.MemoryDocStore()
-    else:
+    elif request.param == "dir":
         s = docstore.DirDocStore(str(tmp_path / "store"))
         yield s
         s.close()
+    else:
+        srv = DocServer().start_background()
+        s = docstore.connect(srv.connstr)
+        yield s
+        s.close()
+        srv.shutdown()
 
 
 def test_insert_find_update_remove(store):
@@ -144,8 +151,20 @@ def test_persistent_table_lock():
     PersistentTable("conf", cnn).lock(timeout=1.0)
 
 
-def _mk_task(status=TASK_STATUS.MAP, lease=30.0):
-    cnn = Connection(f"mem://{uuid.uuid4().hex}", "db")
+@pytest.fixture(params=["mem", "http"])
+def connstr(request):
+    """The task fault suite (claim atomicity, lease reap, heartbeat) must
+    hold over the networked board too — VERDICT r3 item 1."""
+    if request.param == "mem":
+        yield f"mem://{uuid.uuid4().hex}"
+    else:
+        srv = DocServer().start_background()
+        yield srv.connstr
+        srv.shutdown()
+
+
+def _mk_task(connstr, status=TASK_STATUS.MAP, lease=30.0):
+    cnn = Connection(connstr, "db")
     task = Task(cnn, job_lease=lease)
     task.create_collection(status, {
         "taskfn": "m", "mapfn": "m", "partitionfn": "m", "reducefn": "m",
@@ -154,8 +173,8 @@ def _mk_task(status=TASK_STATUS.MAP, lease=30.0):
     return cnn, task
 
 
-def test_task_claim_and_status():
-    cnn, task = _mk_task()
+def test_task_claim_and_status(connstr):
+    cnn, task = _mk_task(connstr)
     task.insert_jobs(task.map_jobs_ns(),
                      [make_job(0, "f0"), make_job(1, "f1")])
     job, st = task.take_next_job("w1", "tmp1")
@@ -173,8 +192,8 @@ def test_task_claim_and_status():
     assert job4 is None and st4 == TASK_STATUS.FINISHED
 
 
-def test_task_lease_reaping():
-    cnn, task = _mk_task(lease=0.0)  # leases expire immediately
+def test_task_lease_reaping(connstr):
+    cnn, task = _mk_task(connstr, lease=0.0)  # leases expire immediately
     task.insert_jobs(task.map_jobs_ns(), [make_job(0, "f0")])
     job, _ = task.take_next_job("w1", "t")
     assert job is not None
@@ -188,8 +207,8 @@ def test_task_lease_reaping():
     assert job2 is not None and job2["_id"] == job["_id"]
 
 
-def test_task_heartbeat_extends_lease():
-    cnn, task = _mk_task(lease=0.05)
+def test_task_heartbeat_extends_lease(connstr):
+    cnn, task = _mk_task(connstr, lease=0.05)
     task.insert_jobs(task.map_jobs_ns(), [make_job(0, "f0")])
     job, _ = task.take_next_job("w1", "t")
     old = job["lease_expires"]
