@@ -204,12 +204,9 @@ class HttpDocStore(DocStore):
     """
 
     def __init__(self, address: str) -> None:
-        host, _, port = address.partition(":")
-        if not port:
-            raise ValueError(
-                f"http docstore wants HOST:PORT, got {address!r}")
-        self.host, self.port = host, int(port)
-        self._client = KeepAliveClient(self.host, self.port)
+        self._client = KeepAliveClient.from_address(
+            address, what="http docstore")
+        self.host, self.port = self._client.host, self._client.port
 
     def _rpc(self, op: str, **fields: Any) -> Any:
         payload: Dict[str, Any] = {"op": op, **fields}

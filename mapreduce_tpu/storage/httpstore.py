@@ -56,6 +56,26 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         name = self._name()
         if name is None:
             return self._respond(404)
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            # bounded-memory slice for the client's streaming line reader;
+            # published blobs are immutable so per-slice consistency holds
+            try:
+                start_s, _, end_s = rng[len("bytes="):].partition("-")
+                start, end = int(start_s), int(end_s)
+            except ValueError:
+                return self._respond(400)
+            if start < 0 or end < start:
+                return self._respond(400)
+            try:
+                chunk = self.store.read_range(name, start, end - start + 1)
+            except FileNotFoundError:
+                return self._respond(404)
+            self.send_response(206)
+            self.send_header("Content-Length", str(len(chunk)))
+            self.end_headers()
+            self.wfile.write(chunk)
+            return
         try:  # read-then-404: no exists/read TOCTOU vs concurrent DELETE
             content = self.store.read(name)
         except FileNotFoundError:
@@ -122,22 +142,19 @@ class HttpStorage(Storage):
     scheme = "http"
 
     def __init__(self, address: str) -> None:
-        host, _, port = address.partition(":")
-        if not port:
-            raise ValueError(
-                f"http storage wants HOST:PORT, got {address!r}")
-        self.host, self.port = host, int(port)
-        self._client = KeepAliveClient(self.host, self.port)
+        self._client = KeepAliveClient.from_address(
+            address, what="http storage")
+        self.host, self.port = self._client.host, self._client.port
 
-    def _request(self, method: str, path: str, body: Optional[bytes] = None
-                 ) -> Tuple[int, bytes]:
+    def _request(self, method: str, path: str, body: Optional[bytes] = None,
+                 headers: Optional[dict] = None) -> Tuple[int, bytes]:
         """The KeepAliveClient retry is blind (the first attempt may have
         been applied before the socket broke), which is safe ONLY because
         every mutating blob endpoint is idempotent: PUT publishes whole
         content atomically and DELETE converges.  A future non-idempotent
         endpoint must not ride this path — give it request-id dedupe like
         the docserver's mutating RPCs (coord/docserver.py)."""
-        return self._client.request(method, path, body=body)
+        return self._client.request(method, path, body=body, headers=headers)
 
     def _blob_path(self, name: str) -> str:
         return "/blobs/" + urllib.parse.quote(name, safe="")
@@ -154,10 +171,39 @@ class HttpStorage(Storage):
             raise FileNotFoundError(f"{name!r}: HTTP {status}")
         return body.decode()
 
+    #: Range-GET slice size for open_lines.  Memory held client-side is
+    #: O(LINES_CHUNK + longest line), never the whole blob — the role of
+    #: the reference's chunk-boundary-aware GridFS line iterator
+    #: (utils.lua:133-200).
+    LINES_CHUNK = 1 << 20
+
     def open_lines(self, name: str) -> Iterator[str]:
-        for line in self.read(name).split("\n"):
-            if line:
-                yield line
+        chunk_size = self.LINES_CHUNK
+        offset = 0
+        buf = b""
+        while True:
+            status, body = self._request(
+                "GET", self._blob_path(name),
+                headers={"Range":
+                         f"bytes={offset}-{offset + chunk_size - 1}"})
+            if status == 404:
+                raise FileNotFoundError(f"{name!r}: HTTP 404")
+            if status == 200:
+                # server without Range support answered with the whole blob
+                buf, body = body, b""
+            elif status != 206:
+                raise IOError(f"blob GET {name!r}: HTTP {status}")
+            else:
+                buf += body
+            *lines, buf = buf.split(b"\n")
+            for ln in lines:
+                if ln:
+                    yield ln.decode()
+            if status == 200 or len(body) < chunk_size:
+                break
+            offset += chunk_size
+        if buf:
+            yield buf.decode()
 
     def _all_names(self) -> List[str]:
         status, body = self._request("GET", "/list")

@@ -33,23 +33,34 @@ class LocalDirStorage(Storage):
     def _fname(self, name: str) -> str:
         return os.path.join(self.root, urllib.parse.quote(name, safe=""))
 
+    # Explicit utf-8 everywhere: byte offsets served by read_range must
+    # agree with the text the str API reads/writes even on hosts whose
+    # locale encoding differs.
+
     def _publish(self, name: str, content: str) -> None:
         tmp = os.path.join(self.root, self.STAGING,
                            f"{os.getpid()}.{uuid.uuid4().hex[:8]}")
-        with open(tmp, "w") as f:
+        with open(tmp, "w", encoding="utf-8") as f:
             f.write(content)
         os.rename(tmp, self._fname(name))  # same fs: atomic
 
     def open_lines(self, name: str) -> Iterator[str]:
-        with open(self._fname(name), "r") as f:
+        with open(self._fname(name), "r", encoding="utf-8") as f:
             for line in f:
                 line = line.rstrip("\n")
                 if line:
                     yield line
 
     def read(self, name: str) -> str:
-        with open(self._fname(name), "r") as f:
+        with open(self._fname(name), "r", encoding="utf-8") as f:
             return f.read()
+
+    def read_range(self, name: str, start: int, length: int) -> bytes:
+        """Bounded-memory byte slice (serves the blob server's Range GETs;
+        b"" past EOF)."""
+        with open(self._fname(name), "rb") as f:
+            f.seek(start)
+            return f.read(length)
 
     def _all_names(self) -> List[str]:
         out = []

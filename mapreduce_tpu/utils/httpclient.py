@@ -21,6 +21,19 @@ class KeepAliveClient:
         self._cnn: Optional[http.client.HTTPConnection] = None
         self._lock = threading.Lock()
 
+    @classmethod
+    def from_address(cls, address: str, timeout: float = 60.0,
+                     what: str = "http endpoint") -> "KeepAliveClient":
+        """Parse ``HOST:PORT`` (the one place this syntax is owned)."""
+        host, _, port = address.partition(":")
+        try:
+            port_n = int(port)
+        except ValueError:
+            port_n = 0
+        if not host or not port or port_n <= 0:
+            raise ValueError(f"{what} wants HOST:PORT, got {address!r}")
+        return cls(host, port_n, timeout)
+
     def request(self, method: str, path: str,
                 body: Optional[bytes] = None,
                 headers: Optional[Dict[str, str]] = None,

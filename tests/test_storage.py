@@ -63,6 +63,36 @@ def test_names_with_odd_characters(store):
         assert name in store.list()
 
 
+def test_http_open_lines_streams_bounded(tmp_path):
+    """A multi-MB blob is read through http in Range-GET slices, never as
+    one body: per-request transfer stays <= LINES_CHUNK (+ the longest
+    line finishing a slice), the reference's chunk-boundary-aware GridFS
+    iterator contract (utils.lua:133-200)."""
+    srv = BlobServer(str(tmp_path / "served"), port=0).start_background()
+    try:
+        st = HttpStorage(srv.address)
+        st.LINES_CHUNK = 4096  # tiny slices so a ~300KB blob needs many
+        lines = [f"word{i} " * 8 for i in range(4000)]
+        lines[1234] = "x" * 20000  # one line longer than the slice size
+        st.write("big", "\n".join(lines) + "\n")
+
+        sizes = []
+        orig = st._request
+
+        def spy(method, path, body=None, headers=None):
+            status, data = orig(method, path, body=body, headers=headers)
+            if method == "GET" and headers and "Range" in headers:
+                sizes.append(len(data))
+            return status, data
+
+        st._request = spy
+        assert list(st.open_lines("big")) == lines
+        assert len(sizes) > 10          # genuinely sliced, not one body
+        assert max(sizes) <= 4096       # each transfer bounded
+    finally:
+        srv.shutdown()
+
+
 def test_storage_dsl():
     assert get_storage_from("mem:foo") == ("mem", "foo")
     assert get_storage_from("shared:/tmp/x") == ("shared", "/tmp/x")
