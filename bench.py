@@ -29,28 +29,79 @@ BASELINE_S = 47.372          # reference README.md:70, 4 workers
 N_WORDS = 49_158_635         # reference README.md:43-45
 N_LINES = 1_965_734
 VOCAB = 80_000
-WORD_W = 8                   # fixed byte width per token incl. separator
+N_PUNCT_VOCAB = 10_000       # vocab entries that are word+punctuation
+N_LONG = 5                   # distinct >128-byte tokens (tail words)
+LONG_REPEATS = 8             # occurrences of each tail word
 
 
 def make_corpus(n_words: int = N_WORDS, n_lines: int = N_LINES,
                 vocab_size: int = VOCAB, seed: int = 0) -> bytes:
-    """Zipf-ish text at Europarl scale, built with vectorised numpy (no
-    Python loop over 49M tokens)."""
+    """Europarl-shaped text at Europarl scale, built with vectorised numpy
+    (no Python loop over 49M tokens): variable Zipf-ranked token lengths
+    (natural ~5-char mean instead of fixed-width cells), ~12% of the
+    vocabulary carrying attached punctuation ("word," and "word" co-occur
+    as distinct whitespace tokens, as in the real corpus), and a tail of
+    >128-byte tokens so the materialise window-overflow fallback
+    (engine/wordcount.py) runs at full scale."""
     rng = np.random.default_rng(seed)
     letters = np.frombuffer(b"abcdefghijklmnopqrstuvwxyz", dtype=np.uint8)
-    lengths = rng.integers(2, WORD_W, size=vocab_size)  # 2..7 chars
-    vocab = np.full((vocab_size, WORD_W), ord(" "), dtype=np.uint8)
-    mask = np.arange(WORD_W)[None, :] < lengths[:, None]
+    MAXW = 16
+
+    # vocabulary: variable lengths ~Binomial(12,.35)+1 (mean ~5.2 chars)
+    n_base = vocab_size - N_PUNCT_VOCAB
+    lengths = (1 + rng.binomial(12, 0.35, size=vocab_size)).astype(np.int32)
+    np.minimum(lengths, MAXW - 1, out=lengths)
+    vocab = np.zeros((vocab_size, MAXW), dtype=np.uint8)
+    mask = np.arange(MAXW)[None, :] < lengths[:, None]
     vocab[mask] = letters[rng.integers(0, 26, size=int(mask.sum()))]
-    # Zipf ranks
+    # punctuation-attached variants: copies of base words + one of .,;:!?
+    punct = np.frombuffer(b".,;:!?", dtype=np.uint8)
+    base_of = rng.integers(0, n_base, size=N_PUNCT_VOCAB)
+    vocab[n_base:] = vocab[base_of]
+    lengths[n_base:] = lengths[base_of]
+    vocab[np.arange(n_base, vocab_size),
+          lengths[n_base:]] = punct[rng.integers(0, 6, N_PUNCT_VOCAB)]
+    lengths[n_base:] += 1
+
+    # Zipf-ranked draw (punct variants ride their base word's rank zone)
     p = 1.0 / (np.arange(vocab_size) + 10.0)
     p /= p.sum()
-    ids = rng.choice(vocab_size, size=n_words, p=p)
-    arr = vocab[ids]  # [n_words, W]
+    n_tail = N_LONG * LONG_REPEATS if n_words > 2 * N_LONG * LONG_REPEATS \
+        else 0
+    ids = rng.choice(vocab_size, size=n_words - n_tail, p=p)
+
+    # variable-width assembly: scatter word bytes at cumsum offsets,
+    # chunked so the [C, W] index temporaries stay ~100MB
+    widths = (lengths[ids] + 1).astype(np.int64)  # +1 separator byte
+    offsets = np.concatenate([np.zeros(1, np.int64), np.cumsum(widths)])
+    out = np.empty(int(offsets[-1]), dtype=np.uint8)
+    CH = 1 << 22
+    for lo in range(0, ids.size, CH):
+        idc = ids[lo:lo + CH]
+        L = lengths[idc]
+        W = int(L.max())
+        span = np.arange(W)
+        m = span[None, :] < L[:, None]
+        flat = (offsets[lo:lo + idc.size, None] + span[None, :])[m]
+        out[flat] = vocab[idc][:, :W][m]
+    sep_pos = offsets[1:] - 1
+    out[sep_pos] = ord(" ")
     # newline terminators at the line cadence of the reference corpus
     line_every = max(n_words // n_lines, 1)
-    arr[line_every - 1::line_every, WORD_W - 1] = ord("\n")
-    return arr.tobytes()
+    out[sep_pos[line_every - 1::line_every]] = ord("\n")
+
+    if not n_tail:
+        return out.tobytes()
+    # >128-byte tail words (window is 128; these must take the fallback)
+    tail_words = []
+    for i in range(N_LONG):
+        ln = int(rng.integers(140, 200))
+        tail_words.append(bytes(letters[rng.integers(0, 26, ln)]))
+    tail = bytearray()
+    for r in range(LONG_REPEATS):
+        for w in tail_words:
+            tail += w + (b"\n" if r % 3 == 2 else b" ")
+    return out.tobytes() + bytes(tail)
 
 
 def main() -> None:
@@ -98,8 +149,11 @@ def main() -> None:
     # order.
     print(f"# corpus ready ({len(corpus)/1e6:.0f} MB, {gen_s:.1f}s); "
           f"staging {n_runs} input copies ...", file=sys.stderr, flush=True)
-    # NOTE: device HBM peaks at n_runs+1 corpus copies during warmup
-    # (~1.6GB at scale 1.0); large BENCH_SCALE values should drop n_runs
+    # NOTE: the staged copies coexist until their runs consume them, so
+    # HBM holds up to n_runs corpus copies here BY CHOICE (the cold-client
+    # transfer trick); the engine itself streams — count_bytes (warmup
+    # below) peaks at ~2 waves regardless of corpus size, and each timed
+    # run frees its staged waves as it folds them
     staged_runs = []
     for r in range(n_runs):
         t1 = time.time()
@@ -115,6 +169,31 @@ def main() -> None:
           flush=True)
     total = sum(counts.values())
     assert total == int(N_WORDS * scale), total
+
+    # full-scale independent oracle: the in-tree C++ tokenizer/aggregator
+    # (native/mr_native.cpp) counts the same bytes through a completely
+    # separate code path; ANY mismatch — missing word, wrong count — is a
+    # hard failure (the reference's perf table is backed by the same kind
+    # of oracle diff, test.sh:11-15)
+    from mapreduce_tpu import native
+
+    if native.native_available():
+        t_o = time.time()
+        oracle = native.wordcount_bytes(corpus)
+        if counts != oracle:
+            only_dev = set(counts) - set(oracle)
+            only_orc = set(oracle) - set(counts)
+            bad = [w for w in (set(counts) & set(oracle))
+                   if counts[w] != oracle[w]]
+            print(f"ORACLE MISMATCH: {len(only_dev)} device-only words, "
+                  f"{len(only_orc)} oracle-only, {len(bad)} wrong counts "
+                  f"(e.g. {bad[:3]})", file=sys.stderr)
+            sys.exit(1)
+        print(f"# native oracle agrees: {len(oracle)} uniques, "
+              f"{time.time() - t_o:.1f}s", file=sys.stderr, flush=True)
+    else:
+        print("# WARNING: native oracle unavailable (no g++); "
+              "only the total-count check ran", file=sys.stderr)
 
     # best of N timed runs: the tunnelled link's bandwidth also swings
     # >10x with ambient load (per-run stages go to stderr so the

@@ -82,6 +82,102 @@ class DeviceResult(NamedTuple):
     overflow: int         # total dropped rows across all stages (0 = exact)
 
 
+class _WaveFeeder:
+    """Streams the chunk batch to the device wave by wave.
+
+    Waves are contiguous per-device blocks (full waves are zero-copy numpy
+    views of the caller's array; only the final partial wave pays a pad
+    copy), each placed sharded over the data axis with one
+    ``jax.device_put`` carrying *global* chunk indices so payload byte
+    offsets stay corpus-global across waves.
+
+    ``get(w)`` resolves wave *w*, submitting background ``device_put``\\ s
+    for at most *prefetch* waves ahead (``device_put`` pays a synchronous
+    host staging copy before the DMA, so puts run on worker threads to
+    overlap that memcpy with compute).  ``release(w)`` drops the device
+    references so wave *w*'s HBM is reclaimed as soon as its consuming
+    program finishes — peak input memory is ~*prefetch* waves, never the
+    corpus.  ``reset()`` forgets consumed waves so a capacity retry
+    re-uploads.  ``close()`` cancels outstanding uploads and joins the
+    pool, so a failed wave never leaves orphan upload threads.
+    """
+
+    def __init__(self, engine: "DeviceEngine", chunks: np.ndarray,
+                 waves: int, prefetch: int = None) -> None:
+        self._chunks = chunks
+        S = chunks.shape[0]
+        self.n_dev = engine.n_dev
+        k = -(-S // (waves * self.n_dev))  # chunks per device per wave
+        self.rpw = k * self.n_dev          # rows per wave
+        self.waves = -(-S // self.rpw)  # drop waves that would be all-pad
+        self.S = S
+        self.prefetch = (self.waves if prefetch is None
+                         else max(1, prefetch))
+        self._sharding = NamedSharding(engine.mesh, P(AXIS))
+        self._pool = None
+        self._futs: dict = {}
+        self._ready: dict = {}
+        self._submitted = 0
+
+    @property
+    def n_real(self) -> np.int32:
+        """True chunk count; indices beyond it are padding whose records
+        the program masks out."""
+        return np.int32(self.S)
+
+    def _put_wave(self, w: int):
+        lo = w * self.rpw
+        chunks = self._chunks
+        if lo + self.rpw <= self.S:
+            block = chunks[lo:lo + self.rpw]  # zero-copy view
+        else:  # final wave: pad with zero chunks (masked via n_real)
+            block = np.zeros((self.rpw,) + chunks.shape[1:],
+                             dtype=chunks.dtype)
+            block[:self.S - lo] = chunks[lo:]
+        dev_chunks = jax.device_put(block, self._sharding)
+        idx = np.arange(lo, lo + self.rpw, dtype=np.int32)
+        dev_idx = jax.device_put(idx, self._sharding)
+        return dev_chunks, dev_idx
+
+    def _ensure_submitted(self, upto: int) -> None:
+        import concurrent.futures as cf
+
+        upto = min(upto, self.waves - 1)
+        if self._submitted > upto:
+            return
+        if self._pool is None:
+            self._pool = cf.ThreadPoolExecutor(
+                max_workers=min(self.waves, 8))
+        for w in range(self._submitted, upto + 1):
+            self._futs[w] = self._pool.submit(self._put_wave, w)
+        self._submitted = upto + 1
+
+    def get(self, w: int):
+        """Resolved ``(dev_chunks [k*n_dev, ...], dev_idx [k*n_dev])``."""
+        self._ensure_submitted(w + self.prefetch - 1)
+        if w not in self._ready:
+            self._ready[w] = self._futs.pop(w).result()
+        return self._ready[w]
+
+    def release(self, w: int) -> None:
+        self._ready.pop(w, None)
+
+    def reset(self) -> None:
+        self.close()
+        self._submitted = 0
+
+    def close(self) -> None:
+        for f in self._futs.values():
+            f.cancel()
+        if self._pool is not None:
+            # wait: a put mid-flight holds a chunks view; freeing device
+            # buffers is then just the dict clears below
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        self._futs.clear()
+        self._ready.clear()
+
+
 class DeviceEngine:
     """Compile-once, run-many device MapReduce over a mesh.
 
@@ -242,12 +338,15 @@ class DeviceEngine:
     #: target host bytes per pipeline wave (auto wave count); ~48MB keeps
     #: each wave's transfer ≈ its compute on the tunnelled v5e link
     WAVE_BYTES = 48 << 20
-    MAX_WAVES = 8
 
     def _auto_waves(self, chunks: np.ndarray) -> int:
+        # no upper cap on the count: the streaming fold keeps peak HBM at
+        # ~STREAM_PREFETCH waves regardless of W, and the pairwise merge
+        # is shape-stable so W never costs another compile — wave SIZE
+        # staying ~WAVE_BYTES is what bounds memory as corpora grow
         by_bytes = max(1, round(chunks.nbytes / self.WAVE_BYTES))
         by_rows = max(1, chunks.shape[0] // self.n_dev)
-        return min(self.MAX_WAVES, by_bytes, by_rows)
+        return min(by_bytes, by_rows)
 
     def _multiprocess(self) -> bool:
         """True when the mesh spans devices of other JAX processes
@@ -272,57 +371,24 @@ class DeviceEngine:
         out = [np.asarray(a) for a in arrays]
         return out[0] if len(out) == 1 else out
 
-    def _shard_inputs(self, chunks: np.ndarray, waves: int = 1):
-        """Split the chunk batch into *waves* equal groups, each placed
-        sharded over the data axis as one plain ``jax.device_put`` with a
-        ``NamedSharding`` — contiguous per-device blocks, so full waves are
-        zero-copy numpy views of the caller's array (only the final
-        partial wave pays a pad copy), and JAX's own device->slice map
-        handles model-axis replication on any mesh shape.
+    #: waves of input kept in flight ahead of the consuming program in the
+    #: streaming run path: upload of wave w+1 overlaps compute of wave w,
+    #: while peak device input memory stays ~2 waves instead of the whole
+    #: corpus (the reference streams unbounded inputs through bounded
+    #: iterators, utils.lua:133-200; this is the HBM analogue)
+    STREAM_PREFETCH = 2
 
-        Returns ``(wave_list, n_real)`` where each wave entry is
-        ``(dev_chunks [k*n_dev, ...], dev_idx [k*n_dev])`` with *global*
-        chunk indices (so payload byte offsets stay corpus-global across
-        waves) and ``n_real`` is the true chunk count — indices beyond it
-        are padding whose records the program masks out.
-
-        Each wave's put is issued from a worker thread: ``device_put``
-        pays a synchronous host staging copy before the DMA, so issuing
-        the waves from one thread would serialize ~hundreds of MB of
-        memcpy ahead of the first compute dispatch.  The returned wave
-        entries hold futures; callers resolve them in order (round 2's
-        12-slab assembly plus two full-corpus host copies was strictly
-        slower than this on every link condition measured)."""
-        import concurrent.futures as cf
-
-        S = chunks.shape[0]
-        k = -(-S // (waves * self.n_dev))  # chunks per device per wave
-        rpw = k * self.n_dev               # rows per wave
-        waves = -(-S // rpw)  # drop trailing waves that would be all-pad
-        sharding = NamedSharding(self.mesh, P(AXIS))
-
-        def put_wave(w: int):
-            lo = w * rpw
-            if lo + rpw <= S:
-                block = chunks[lo:lo + rpw]  # zero-copy view
-            else:  # final wave: pad with zero chunks (masked via n_real)
-                block = np.zeros((rpw,) + chunks.shape[1:],
-                                 dtype=chunks.dtype)
-                if lo < S:
-                    block[:S - lo] = chunks[lo:]
-            dev_chunks = jax.device_put(block, sharding)
-            idx = np.arange(lo, lo + rpw, dtype=np.int32)
-            dev_idx = jax.device_put(idx, sharding)
-            return dev_chunks, dev_idx
-
-        if waves == 1:
-            return [put_wave(0)], np.int32(S)
-        pool = cf.ThreadPoolExecutor(max_workers=min(waves, 8))
-        try:
-            wave_list = [pool.submit(put_wave, w) for w in range(waves)]
-        finally:
-            pool.shutdown(wait=False)
-        return wave_list, np.int32(S)
+    def _max_inflight_programs(self) -> int:
+        """Wave programs allowed in the dispatch queue before the driver
+        blocks on an older wave's completion.  On TPU the per-device queue
+        executes serially and a modest depth keeps dispatch pipelined (and
+        bounds the output buffers of un-folded waves).  On the CPU backend
+        every queued shard occupies a thread-pool worker, so shards of
+        later waves can starve an earlier wave's all_to_all rendezvous of
+        its participants — a deadlock XLA aborts after 40s; strict
+        serialization is the only safe depth there."""
+        platform = next(iter(self.mesh.devices.flat)).platform
+        return 4 if platform == "tpu" else 1
 
     @staticmethod
     def _fit(need: int) -> int:
@@ -330,7 +396,7 @@ class DeviceEngine:
         need = int(need * 1.25) + 16
         return 1 << max(need - 1, 1).bit_length()
 
-    def _resize(self, cfg: EngineConfig, outs) -> EngineConfig:
+    def _resize(self, cfg: EngineConfig, need_arrays) -> EngineConfig:
         """Right-size capacities from the failed run's measured needs
         (program output lane 5: [local uniques, exchange per-dest max,
         final uniques, map drops] per device) — one informed recompile
@@ -338,8 +404,8 @@ class DeviceEngine:
         measure-then-size on the run we already paid for).  Needs are
         lower bounds when an earlier stage truncated, so the loop may
         take a second sizing pass; it never regresses a capacity."""
-        hosted = self._host(*[o[5] for o in outs])  # one batched gather
-        needs = np.stack(hosted if len(outs) > 1 else [hosted])
+        hosted = self._host(*need_arrays)  # one batched gather
+        needs = np.stack(hosted if len(need_arrays) > 1 else [hosted])
         # [W, dev, 4]
         local_need = int(needs[:, :, 0].max())
         ex_need = int(needs[:, :, 1].max())
@@ -366,104 +432,179 @@ class DeviceEngine:
         executed (on the tunnelled dev platform that path measures
         ~25-50x faster — see scratch/prof_poison3.py), and a user
         streaming a corpus can stage the next batch while deciding what
-        to run.  ``run(chunks, staged=...)`` then charges no upload."""
+        to run.  ``run(chunks, staged=...)`` then charges no upload.
+
+        Unlike the streaming run path (bounded at ~STREAM_PREFETCH waves),
+        a staged handle holds the WHOLE corpus in device memory — that is
+        its point.  The handle is single-use: :meth:`run` consumes it,
+        freeing each wave as soon as its program completes."""
         W = self._auto_waves(chunks) if waves is None else max(1, waves)
-        wave_inputs, n_real = self._shard_inputs(chunks, W)
-        resolved = [wi if isinstance(wi, tuple) else wi.result()
-                    for wi in wave_inputs]
+        feeder = _WaveFeeder(self, chunks, W)  # prefetch=all
+        resolved = [feeder.get(w) for w in range(feeder.waves)]
+        n_real = feeder.n_real
+        feeder.close()  # resolved list owns the references now
         jax.block_until_ready([a for pair in resolved for a in pair])
         return resolved, n_real
 
     def run(self, chunks: np.ndarray, max_retries: int = 3,
             timings: dict = None, waves: int = None,
-            staged=None) -> DeviceResult:
+            staged=None, on_overflow: str = "raise") -> DeviceResult:
         """Execute over *chunks* ([S, ...] host array, sharded over the
         mesh), growing capacities until no stage overflowed.
 
         *waves* (default: auto from input size) pipelines the host->device
-        link against the TPU: the input is shipped as several sharded
-        transfers, each wave's map/sort/shuffle program is dispatched
-        asynchronously as soon as its transfer is issued, and a final
-        on-device program folds the waves' per-partition uniques.  Upload
-        of wave i+1 thus overlaps compute of wave i (the round-2 engine
-        serialized a single monolithic upload before any compute).
+        link against the TPU AND bounds device memory: each wave's input
+        is uploaded (at most STREAM_PREFETCH waves in flight), its
+        map/sort/shuffle program dispatched, its per-partition uniques
+        folded into the running result by an on-device merge, and its
+        input FREED — peak HBM is ~2 wave inputs + the accumulated
+        uniques, never the corpus (the reference's bounded-memory input
+        iterators, utils.lua:133-200, done for HBM).
 
         Pass ``timings={}`` to receive per-stage wall seconds — the
         device-path analogue of the host server's per-phase stats
-        (server.lua:555-600).  With waves > 1 the stages overlap:
-        ``upload_s`` is the wall time until every input shard was
-        resident, ``compute_s`` the remaining tail until all programs
-        finished.
+        (server.lua:555-600).  With waves > 1 the stages genuinely
+        overlap: ``upload_s`` is the wall time the driver spent *waiting*
+        on transfers, ``compute_s`` the rest of the attempt.
 
         With ``staged`` (from :meth:`stage_inputs`) the *chunks* and
-        *waves* arguments are ignored: the staged handle fixes both the
-        data and its wave split, and no upload is charged to timings."""
+        *waves* arguments don't pick the data: the handle fixes both the
+        data and its wave split, and no upload is charged to timings.
+        The handle is CONSUMED — each wave is freed after its fold (pass
+        the same *chunks* the handle was built from to keep capacity
+        retries possible; they re-upload, streaming).
+
+        If capacities still overflow after *max_retries* right-sized
+        recompiles, raises ``RuntimeError`` — a truncated result never
+        escapes accidentally.  Pass ``on_overflow="return"`` to receive
+        the truncated ``DeviceResult`` (``.overflow`` > 0) instead."""
         if staged is not None and waves is not None:
             raise ValueError(
                 "run(staged=...) uses the handle's wave split; "
                 "pass waves to stage_inputs instead")
+        if on_overflow not in ("raise", "return"):
+            raise ValueError(f"on_overflow must be 'raise' or 'return', "
+                             f"got {on_overflow!r}")
         import time
 
         cfg = self.config
         t_start = time.time()
+        feeder = None
+        pairs = None  # staged, pre-resolved waves (consumed in place)
         if staged is not None:
-            pre_resolved, n_real = staged
-            wave_inputs = list(pre_resolved)
+            staged_list, n_real = staged
+            W = len(staged_list)
+            if W == 0:
+                raise RuntimeError(
+                    "staged handle already consumed (handles are "
+                    "single-use: each wave is freed as it is folded); "
+                    "stage_inputs again for another run")
+            pairs = {w: staged_list[w] for w in range(W)}
+            # consume the handle: freeing below must work even while the
+            # caller still holds it
+            staged_list.clear()
         else:
             W = self._auto_waves(chunks) if waves is None else max(1, waves)
-            # input transfer does not depend on capacities: issue it
-            # once, not once per retry
-            wave_inputs, n_real = self._shard_inputs(chunks, W)
-        W = len(wave_inputs)  # may have been clamped to data-bearing waves
-        resolved = {}
+            feeder = _WaveFeeder(self, chunks, W,
+                                 prefetch=self.STREAM_PREFETCH)
+            W = feeder.waves  # clamped to data-bearing waves
+            n_real = feeder.n_real
 
-        def wave(w):
-            if w not in resolved:
-                wi = wave_inputs[w]
-                resolved[w] = wi if isinstance(wi, tuple) else wi.result()
-            return resolved[w]
-
-        t_upload = None  # measured once: retries reuse resident inputs
+        t_upload = 0.0
         t_compute = 0.0
         retries = 0
-        for attempt in range(max_retries + 1):
-            fn = self._get_compiled(cfg)
-            t0 = time.time()
-            # dispatch each wave once its input is RESIDENT: wave w's
-            # program runs while waves w+1.. still stream in background
-            # threads, and no program ever queues against an in-flight
-            # transfer (measured to throttle the tunnelled link)
-            outs = []
-            for w in range(W):
-                ci, ii = wave(w)
-                jax.block_until_ready(ci)
-                outs.append(fn(ci, ii, n_real))
-            oflows = [o[4] for o in outs]
-            if len(outs) > 1:
-                merge = self._get_merge(cfg)
-                cat = lambda i: jnp.concatenate([o[i] for o in outs],
-                                                axis=1)
-                keys, vals, pay, valid, m_oflow = merge(
-                    cat(0), cat(1), cat(2), cat(3))
-                oflows.append(m_oflow)
-            else:
-                keys, vals, pay, valid = outs[0][:4]
-            jax.block_until_ready([ci for ci, _ in resolved.values()])
-            if t_upload is None:
-                # from t_start: includes _shard_inputs' staging copies
-                t_upload = time.time() - t_start
-                compute_from = time.time()
-            else:
-                compute_from = t0
-            # the (tiny) overflow readbacks force program completion
-            total_oflow = sum(int(self._host(o).sum()) for o in oflows)
-            t_compute += time.time() - compute_from
-            if total_oflow == 0 or attempt == max_retries:
-                break  # done, or out of retries (don't size a cfg that
-                # will never run)
-            retries = attempt + 1
-            cfg = self._resize(cfg, outs)
-        del wave_inputs, resolved, outs
+        try:
+            depth = self._max_inflight_programs()
+            for attempt in range(max_retries + 1):
+                fn = self._get_compiled(cfg)
+                merge = self._get_merge(cfg) if W > 1 else None
+                t0 = time.time()
+                t_blocked = 0.0
+                acc = None
+                oflows = []
+                wave_oflows = []
+                need_arrays = []
+                for w in range(W):
+                    tb = time.time()
+                    if pairs is not None:
+                        ci, ii = pairs[w]
+                    else:
+                        ci, ii = feeder.get(w)
+                    # wave w's program must not queue against an
+                    # in-flight transfer (measured to throttle the
+                    # tunnelled link); the wait is charged to upload
+                    jax.block_until_ready(ci)
+                    t_blocked += time.time() - tb
+                    if w >= depth:
+                        # bound the dispatch queue via a VALUE readback:
+                        # on the tunnelled platform block_until_ready on
+                        # a small array can return before execution
+                        # finishes (measured), which would quietly void
+                        # both the HBM bound and the CPU rendezvous
+                        # serialization
+                        self._host(wave_oflows[w - depth])
+                    out = fn(ci, ii, n_real)
+                    oflows.append(out[4])
+                    wave_oflows.append(out[4])
+                    need_arrays.append(out[5])
+                    if acc is None:
+                        acc = out[:4]
+                    else:
+                        # fold wave w into the running uniques (2C rows —
+                        # shape-stable, so ONE merge compile serves any W)
+                        merged = merge(
+                            *(jnp.concatenate([acc[i], out[i]], axis=1)
+                              for i in range(4)))
+                        acc = merged[:4]
+                        oflows.append(merged[4])
+                    del out
+                    # wave w is consumed: drop its input references so
+                    # the HBM frees the moment its program completes
+                    if pairs is not None:
+                        pairs.pop(w, None)
+                    else:
+                        feeder.release(w)
+                    del ci, ii
+                keys, vals, pay, valid = acc
+                # the (tiny) overflow readbacks force program completion
+                total_oflow = sum(int(self._host(o).sum())
+                                  for o in oflows)
+                # every attempt's transfer waits count: capacity retries
+                # re-upload (inputs were freed wave by wave) and that cost
+                # must show in the stats meant to expose it
+                t_upload += t_blocked
+                t_compute += time.time() - t0 - t_blocked
+                if total_oflow == 0 or attempt == max_retries:
+                    break  # done, or out of retries (don't size a cfg
+                    # that will never run)
+                retries = attempt + 1
+                cfg = self._resize(cfg, need_arrays)
+                del acc, keys, vals, pay, valid
+                # inputs were freed wave by wave: the retry re-uploads
+                if pairs is not None:
+                    if chunks is None:
+                        raise RuntimeError(
+                            "capacity retry needs the input re-uploaded, "
+                            "but the staged handle is consumed and no "
+                            "chunks were passed; call run(chunks, "
+                            "staged=handle) with the handle's source "
+                            "array")
+                    feeder = _WaveFeeder(self, chunks, W,
+                                         prefetch=self.STREAM_PREFETCH)
+                    pairs = None
+                else:
+                    feeder.reset()
+        finally:
+            if feeder is not None:
+                feeder.close()
+            if pairs:
+                pairs.clear()
+        if total_oflow and on_overflow == "raise":
+            raise RuntimeError(
+                f"device run still overflowed {total_oflow} rows after "
+                f"{retries} right-sized retries; raise EngineConfig "
+                "capacities (or max_retries), or pass "
+                "on_overflow='return' to inspect the truncated result")
         # sliced readback: only the live prefix of each partition's
         # capacity-padded result crosses the (slow) device->host link
         t0 = time.time()

@@ -199,13 +199,10 @@ class DeviceWordCount:
 
     def _finish(self, chunks, result,
                 timings: Optional[dict]) -> Dict[bytes, int]:
-        """Shared post-run tail: overflow check + host materialisation."""
+        """Shared post-run tail: host materialisation.  (Truncation cannot
+        reach here: run() raises on exhausted retries by default.)"""
         import time
 
-        if result.overflow:
-            raise RuntimeError(
-                f"wordcount overflowed capacities by {result.overflow} "
-                "rows even after retries; raise EngineConfig capacities")
         t0 = time.time()
         out = materialize_counts(chunks, result)
         if timings is not None:
@@ -256,9 +253,24 @@ def materialize_counts(chunks: np.ndarray, result) -> Dict[bytes, int]:
                 "distinct words were merged on device. Re-run with "
                 "different HASH_A1/HASH_A2 multipliers (ops/tokenize.py).")
 
+    words = gather_words(chunks, gstart)
+    out: Dict[bytes, int] = {}
+    for word, c in zip(words, counts):
+        out[word] = out.get(word, 0) + int(c)
+    return out
+
+
+def gather_words(chunks: np.ndarray, gstarts: np.ndarray):
+    """The word bytes at each padded-space start offset (``chunk*L +
+    local``), as a list aligned with *gstarts* — one numpy window-gather
+    over all offsets, with a per-row Python scan only for words longer
+    than the window (shared by every device workload that materialises
+    string keys from payload offsets)."""
+    S, L = chunks.shape
     flat = chunks.reshape(-1)
+    gstarts = np.asarray(gstarts, dtype=np.int64)
     # windows[i] = corpus bytes [gstart_i, gstart_i + _WINDOW)
-    offs = gstart[:, None] + np.arange(_WINDOW)[None, :]
+    offs = gstarts[:, None] + np.arange(_WINDOW)[None, :]
     np.clip(offs, 0, flat.size - 1, out=offs)
     windows = flat[offs]  # [U, W] uint8
     is_ws = np.isin(windows, _WS_BYTES)
@@ -268,20 +280,18 @@ def materialize_counts(chunks: np.ndarray, result) -> Dict[bytes, int]:
     has_end = is_ws.any(axis=1)
     lengths = np.where(has_end, is_ws.argmax(axis=1), _WINDOW)
 
-    out: Dict[bytes, int] = {}
+    out = []
     win_bytes = windows.tobytes()
     W = _WINDOW
-    for i in range(live_rows.size):
-        ln = lengths[i]
+    for i in range(gstarts.size):
         if has_end[i]:
-            word = win_bytes[i * W:i * W + ln]
+            out.append(win_bytes[i * W:i * W + int(lengths[i])])
         else:  # overlong word: rare fallback, scan the original bytes
-            g = int(gstart[i])
+            g = int(gstarts[i])
             row, col = divmod(g, L)
             end = col
             crow = chunks[row]
             while end < L and crow[end] not in _WS_BYTES:
                 end += 1
-            word = crow[col:end].tobytes()
-        out[word] = out.get(word, 0) + int(counts[i])
+            out.append(crow[col:end].tobytes())
     return out

@@ -1,0 +1,199 @@
+"""Word-span index: the second device-hooks workload (single-module form).
+
+For every word of the corpus: ``[count, first_offset, last_offset]`` —
+the word's occurrence count and the byte offsets of its first and last
+occurrence in the files' concatenated bytes (files joined with ``\\n`` in
+task-key order, the same stream the device plane shards).  An inverted-
+index-shaped workload: multi-lane values reduced by a NON-SUM monoid
+(elementwise ``[sum, min, max]``), run as a callable ``reduce_op``
+through ``Server(device=True)`` and as an ordinary ACI ``reducefn`` on
+the host plane, with identical results.
+
+Why it exists: the reference proves its user contract on two genuinely
+different workloads (WordCount AND the APRIL-ANN trainer,
+examples/APRIL-ANN/common.lua:85-137); wordcount alone proved ours on
+one.  This module exercises everything wordcount's hooks don't:
+multi-lane values, a callable monoid, and payload-offset reconciliation
+between the planes (device offsets live in padded-chunk space and are
+mapped back through ``shard_text``'s chunk origins).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ...utils.hashing import fnv1a32
+
+_conf: Dict[str, Any] = {"files": [], "num_reducers": 8}
+#: finalfn deposits {word: [count, first, last]} here (wordcount.RESULT
+#: pattern)
+RESULT: Dict[str, List[int]] = {}
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+
+def init(args: Any) -> None:
+    if args:
+        _conf.update(args)
+    # base offset of each file in the concatenated stream ("\n"-joined in
+    # task-key order — the exact stream device_prepare builds)
+    import os
+
+    sizes = [os.path.getsize(p) for p in _conf["files"]]
+    bases = []
+    off = 0
+    for s in sizes:
+        bases.append(off)
+        off += s + 1  # +1: the join separator
+    _conf["bases"] = bases
+
+
+def taskfn(emit) -> None:
+    # zero-padded keys: task-key string order == file order, so host and
+    # device planes agree on the concatenation (device_prepare sorts by
+    # str(key))
+    for i, path in enumerate(_conf["files"]):
+        emit(f"{i:04d}", path)
+
+
+def mapfn(key: str, path: str, emit) -> None:
+    base = _conf["bases"][int(key)]
+    with open(path, "rb") as f:
+        data = f.read()
+    import re
+
+    for m in re.finditer(rb"\S+", data):
+        off = base + m.start()
+        emit(m.group().decode("utf-8", "replace"), [1, off, off])
+
+
+def partitionfn(key: str) -> int:
+    return fnv1a32(key.encode("utf-8")) % _conf["num_reducers"]
+
+
+def _fold(values: List[List[int]]) -> List[int]:
+    count = sum(v[0] for v in values)
+    return [count, min(v[1] for v in values), max(v[2] for v in values)]
+
+
+def reducefn(key: str, values: List[List[int]]) -> List[int]:
+    return _fold(values)
+
+
+def combinerfn(key: str, values: List[List[int]]) -> List[int]:
+    return _fold(values)
+
+
+def finalfn(pairs) -> bool:
+    RESULT.clear()
+    for key, values in pairs:
+        RESULT[key] = list(values[0])
+    return True
+
+
+# -- device fast path hooks (spec.DEVICE_HOOKS) ------------------------------
+
+def _span_reduce_op(a, b):
+    """The span monoid, traceable: lane 0 sums counts, lane 1 takes the
+    min first-offset, lane 2 the max last-offset.  Associative and
+    commutative — the compiler-visible form of the ACI flags above."""
+    import jax.numpy as jnp
+
+    return jnp.stack([a[..., 0] + b[..., 0],
+                      jnp.minimum(a[..., 1], b[..., 1]),
+                      jnp.maximum(a[..., 2], b[..., 2])], axis=-1)
+
+
+def device_config():
+    from ...engine import EngineConfig
+
+    return EngineConfig(
+        local_capacity=int(_conf.get("device_local_capacity", 1 << 15)),
+        exchange_capacity=int(_conf.get("device_exchange_capacity",
+                                        1 << 13)),
+        out_capacity=int(_conf.get("device_out_capacity", 1 << 15)),
+        tile=512, tile_records=128,
+        reduce_op=_span_reduce_op, unit_values=False)
+
+
+def device_prepare(pairs, mesh):
+    """Concatenate the taskfn-emitted files and shard over the mesh,
+    remembering each chunk's origin so device offsets (padded-chunk
+    space) can be mapped back to stream offsets in device_result."""
+    from ...ops.tokenize import shard_text
+
+    ordered = sorted(pairs, key=lambda kv: str(kv[0]))
+    data = b"\n".join(open(path, "rb").read() for _, path in ordered)
+    chunk_len = int(_conf.get("device_chunk_len", 1 << 22))
+    n_dev = mesh.shape["data"]
+    n_chunks = max(1, -(-len(data) // chunk_len))
+    n_chunks = -(-n_chunks // n_dev) * n_dev
+    chunks, _L, starts = shard_text(data, n_chunks, pad_multiple=512,
+                                    return_offsets=True)
+    _conf["chunk_starts"] = starts
+    return chunks
+
+
+def device_map(chunk, chunk_index, cfg):
+    """Traceable map: tokenize+hash+compact one byte chunk, emitting
+    values [1, gstart, gstart] for the span monoid (gstart in padded
+    space; device_result converts)."""
+    import jax.numpy as jnp
+
+    from ...ops.compaction import tile_compact
+    from ...ops.tokenize import tokenize_hash
+
+    L = chunk.shape[0]
+    toks = tokenize_hash(chunk)
+    gstart = chunk_index * L + toks.start
+    tc = tile_compact(toks.is_end, cfg.tile, cfg.tile_records,
+                      toks.keys[:, 0], toks.keys[:, 1], gstart)
+    k1, k2, gs = tc.arrays
+    keys = jnp.stack([k1, k2], axis=-1)
+    gs = gs.astype(jnp.int32)
+    ones = tc.valid.astype(jnp.int32)
+    # invalid rows must not poison the min lane: give them INT32_MAX
+    big = jnp.int32(np.iinfo(np.int32).max)
+    values = jnp.stack(
+        [ones, jnp.where(tc.valid, gs, big), jnp.where(tc.valid, gs, -1)],
+        axis=-1)
+    payload = gs[:, None]
+    return keys, values, payload, tc.valid, tc.overflow
+
+
+def device_result(chunks, result):
+    """Host materialisation: unique hashed words -> (word,
+    [[count, first, last]]) with offsets mapped back from padded-chunk
+    space to the concatenated-stream space the host plane reports."""
+    from ...engine.wordcount import gather_words
+
+    S, L = chunks.shape
+    starts = _conf["chunk_starts"]
+    valid = result.valid.reshape(-1)
+    live = np.nonzero(valid)[0]
+    if live.size == 0:
+        return
+    pay = result.payload.reshape(-1, result.payload.shape[-1])[live, 0]
+    vals = result.values.reshape(-1, 3)[live]
+    words = gather_words(chunks, pay.astype(np.int64))
+
+    def to_stream(padded_off):
+        c, j = divmod(int(padded_off), L)
+        return int(starts[c]) + j
+
+    agg: Dict[str, List[int]] = {}
+    for word, (count, first, last) in zip(words, vals):
+        key = word.decode("utf-8", "replace")
+        span = [int(count), to_stream(first), to_stream(last)]
+        got = agg.get(key)
+        if got is None:
+            agg[key] = span
+        else:  # defensive: fold if a word ever appears in two rows
+            agg[key] = [got[0] + span[0], min(got[1], span[1]),
+                        max(got[2], span[2])]
+    for key, span in agg.items():
+        yield key, [span]

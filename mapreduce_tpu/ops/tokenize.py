@@ -214,12 +214,15 @@ def word_hashes_host(text: bytes) -> dict:
 
 
 def shard_text(data: bytes, num_shards: int,
-               pad_multiple: int = 128) -> Tuple[np.ndarray, int]:
+               pad_multiple: int = 128, return_offsets: bool = False):
     """Host prep: split a text blob into ``num_shards`` roughly equal byte
     chunks on whitespace boundaries, space-padded to one common static
     length (multiple of *pad_multiple* for TPU lane alignment).
 
-    Returns ``(chunks [S, L] uint8, L)``.  Splitting only at whitespace
+    Returns ``(chunks [S, L] uint8, L)`` — or, with *return_offsets*,
+    ``(chunks, L, starts [S] int64)`` where ``starts[i]`` is chunk *i*'s
+    byte offset in *data* (so a padded-space offset ``c*L + j`` maps back
+    to original offset ``starts[c] + j``).  Splitting only at whitespace
     keeps every word intact inside exactly one shard — the same invariant
     the reference gets from line-aligned input splits (README.md:43-45).
     """
@@ -239,4 +242,6 @@ def shard_text(data: bytes, num_shards: int,
     for i in range(num_shards):
         lo, hi = bounds[i], bounds[i + 1]
         arr[i, :hi - lo] = flat[lo:hi]  # single memcpy per shard
+    if return_offsets:
+        return arr, L, np.asarray(bounds[:-1], dtype=np.int64)
     return arr, L

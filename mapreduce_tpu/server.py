@@ -359,9 +359,23 @@ class Server:
         assert self.configured, "call configure() before loop()"
         it = 0
         skip_map = False
+        # the execution plane is decided ONCE: params, falling back to the
+        # persisted task doc on resume — so a crashed device-mode task
+        # resumed by a server configured without device=True (or vice
+        # versa) stays on the plane the original run recorded instead of
+        # silently switching mid-task (ADVICE r3)
+        device = bool(self.params.get("device"))
         # crash recovery (server.lua:468-491)
         if self.task.update():
             st = self.task.status()
+            if st != TASK_STATUS.FINISHED:
+                # resuming: the PERSISTED plane wins in both directions —
+                # a device-configured server must not hijack a host-plane
+                # task mid-run (abandoning its stored map output) any more
+                # than the reverse
+                doc_device = self.task.tbl.get("device")
+                if doc_device is not None:
+                    device = bool(doc_device)
             if st == TASK_STATUS.FINISHED:
                 self.drop_collections()
             elif st == TASK_STATUS.REDUCE:
@@ -370,7 +384,7 @@ class Server:
                 # restore storage decisions from the surviving task doc
                 self.params["storage"] = self.task.tbl["storage"]
                 self.params["path"] = self.task.tbl["path"]
-                if self.params.get("device") or self.task.tbl.get("device"):
+                if device:
                     # the device phase is fused: re-run it whole (its
                     # map output never hits storage, so a REDUCE-state
                     # resume has nothing to reduce from)
@@ -385,7 +399,7 @@ class Server:
                 it = max(self.task.iteration() - 1, 0)
 
         while not self.finished:
-            if self.params.get("device"):
+            if device:
                 # unified device fast path: ONE fused SPMD phase replaces
                 # map + shuffle + reduce; taskfn/finalfn/stats/loop stay
                 # exactly the host machinery

@@ -207,6 +207,91 @@ def test_device_wordcount_wave_pipeline_overflow_retry(wc_mesh):
     assert got == _oracle(data)
 
 
+def test_streaming_run_bounds_live_waves(wc_mesh, monkeypatch):
+    """The streaming run path must never hold more than STREAM_PREFETCH
+    wave inputs on device at once — each wave is freed after its fold
+    (VERDICT r3 item 3: peak HBM ~1-2 waves, not the corpus)."""
+    import mapreduce_tpu.engine.device_engine as de
+
+    live = set()
+    max_live = [0]
+
+    class Spy(de._WaveFeeder):
+        def _put_wave(self, w):
+            pair = super()._put_wave(w)
+            live.add(w)
+            max_live[0] = max(max_live[0], len(live))
+            return pair
+
+        def release(self, w):
+            live.discard(w)
+            super().release(w)
+
+    monkeypatch.setattr(de, "_WaveFeeder", Spy)
+    data = _random_text(n_words=20000, seed=7)
+    wc = DeviceWordCount(wc_mesh, chunk_len=1024)
+    tm = {}
+    got = wc.count_bytes(data, timings=tm, waves=5)
+    assert got == _oracle(data)
+    assert tm["waves"] == 5
+    assert max_live[0] <= de.DeviceEngine.STREAM_PREFETCH, max_live
+
+
+def test_staged_handle_consumed_and_freed(wc_mesh):
+    """A staged handle is single-use: run() frees each wave's device
+    arrays as it folds them, even though the caller still holds the
+    handle (the bench's n_runs staged copies stop accumulating)."""
+    import gc
+    import weakref
+
+    data = _random_text(n_words=4000, seed=8)
+    wc = DeviceWordCount(wc_mesh, chunk_len=1024)
+    handle = wc.stage(data, waves=3)
+    staged_list, _n_real = handle[2]
+    refs = [weakref.ref(a) for pair in staged_list for a in pair]
+    assert len(refs) == 6
+    got = wc.count_staged(handle)
+    assert got == _oracle(data)
+    assert staged_list == []  # consumed in place
+    del handle, staged_list
+    gc.collect()
+    assert all(r() is None for r in refs)
+
+
+def test_staged_run_capacity_retry_reuploads(wc_mesh):
+    """Consuming the staged handle must not break capacity retries: the
+    retry re-uploads from the chunks the caller passed alongside."""
+    data = _random_text(n_words=3000, seed=9)
+    wc = DeviceWordCount(
+        wc_mesh, chunk_len=1024,
+        config=EngineConfig(local_capacity=16, exchange_capacity=8,
+                            out_capacity=16))
+    handle = wc.stage(data, waves=2)
+    tm = {}
+    got = wc.count_staged(handle, timings=tm)
+    assert got == _oracle(data)
+    assert tm["retries"] >= 1
+
+
+def test_run_raises_on_exhausted_retries(wc_mesh):
+    """A truncated result must never escape accidentally: with
+    max_retries=0 and absurd capacities, run() raises (ADVICE r3);
+    on_overflow='return' opts into inspecting the truncation."""
+    from mapreduce_tpu.engine.device_engine import DeviceEngine as DE
+
+    data = _random_text(n_words=3000, seed=10)
+    wc = DeviceWordCount(
+        wc_mesh, chunk_len=1024,
+        config=EngineConfig(local_capacity=4, exchange_capacity=2,
+                            out_capacity=4))
+    chunks, _L = wc._to_chunks(data)
+    eng = wc.engine
+    with pytest.raises(RuntimeError, match="overflow"):
+        eng.run(chunks, max_retries=0)
+    res = eng.run(chunks, max_retries=0, on_overflow="return")
+    assert res.overflow > 0
+
+
 def test_device_wordcount_verify_mode_matches_oracle(wc_mesh):
     """verify_collisions=True carries a third hash lane reduced with
     (min, max); on collision-free text the counts are identical to the
