@@ -620,6 +620,11 @@ class DeviceEngine:
             timings["retries"] = retries
             if staged is None:  # staged callers timed the upload already
                 timings["upload_s"] = round(t_upload, 3)
+            elif t_upload > 0.01:  # resolved-handle waits are ~0
+                # capacity retries re-upload even under a staged handle;
+                # that wait must surface somewhere (a separate key, so it
+                # never double-counts the caller's own staging time)
+                timings["retry_upload_s"] = round(t_upload, 3)
             timings["compute_s"] = round(t_compute, 3)
             timings["readback_s"] = round(t_readback, 3)
             if staged is None:

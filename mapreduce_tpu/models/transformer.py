@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.flash_attention import flash_attention
 from ..parallel.ring import ring_attention
 
 Params = Dict[str, jax.Array]
@@ -72,6 +73,14 @@ class TransformerConfig:
     #: gate gradient is rich-get-richer (the winning expert's logit only
     #: grows) and routing collapses onto one expert
     moe_aux_weight: float = 0.01
+    #: use the in-tree Pallas flash-attention kernel
+    #: (ops/flash_attention.py) for the local attention instead of the
+    #: jnp ring path.  None = auto: on when the sequence is NOT sharded
+    #: (data axis 1 — the kernel computes exact local attention; the
+    #: multi-device ring keeps the jnp online-softmax path) and the
+    #: backend is TPU.  True forces it (tests run the interpreter on
+    #: CPU); False forces the jnp path.
+    flash: Any = None
 
     def validate(self, n_model: int) -> None:
         assert self.n_heads % n_model == 0, "heads must split over model axis"
@@ -136,16 +145,33 @@ def _layer_local(x: jax.Array, lp: Params, cfg: TransformerConfig,
     shard_map); ``lp`` holds this layer's params without the L<i> prefix."""
     H_loc = cfg.n_heads // n_model
     D = cfg.head_dim
+    E = x.shape[-1]
     h = _rmsnorm(x, lp["ln1_scale"].astype(cfg.dtype))
-    qkv = jnp.einsum("bte,ecf->btcf", h, lp["wqkv"].astype(cfg.dtype))
-    q, k, v = [qkv[:, :, j].reshape(*qkv.shape[:2], H_loc, D)
-               for j in range(3)]
-    # bf16 operands on the MXU with f32 softmax/accumulation inside
-    attn = ring_attention(q, k, v, data_axis, causal=True,
-                          block_size=cfg.attn_block).astype(cfg.dtype)
-    attn = attn.reshape(*attn.shape[:2], H_loc * D)
-    # row-sharded output projection -> psum over the model axis
-    o = jnp.einsum("btf,fe->bte", attn, lp["wo"].astype(cfg.dtype))
+    if cfg.flash:
+        # Pallas fast path: project straight into the kernel's
+        # [B, H, T, D] layout (the transpose folds into the matmul
+        # epilogue — nothing is materialised twice), run the tiled
+        # kernel, and contract back in one einsum
+        w = lp["wqkv"].astype(cfg.dtype).reshape(E, 3, H_loc, D)
+        qkv = jnp.einsum("bte,echd->bchtd", h, w)
+        # attn_block doubles as the kernel tile request (auto-shrunk to
+        # divide T); default 512 is the measured sweet spot on v5e
+        bk = dict(block_q=cfg.attn_block, block_kv=cfg.attn_block) \
+            if cfg.attn_block else {}
+        attn = flash_attention(qkv[:, 0], qkv[:, 1], qkv[:, 2],
+                               causal=True, **bk).astype(cfg.dtype)
+        o = jnp.einsum("bhtd,hde->bte", attn,
+                       lp["wo"].astype(cfg.dtype).reshape(H_loc, D, E))
+    else:
+        qkv = jnp.einsum("bte,ecf->btcf", h, lp["wqkv"].astype(cfg.dtype))
+        q, k, v = [qkv[:, :, j].reshape(*qkv.shape[:2], H_loc, D)
+                   for j in range(3)]
+        # bf16 operands on the MXU with f32 softmax/accumulation inside
+        attn = ring_attention(q, k, v, data_axis, causal=True,
+                              block_size=cfg.attn_block).astype(cfg.dtype)
+        attn = attn.reshape(*attn.shape[:2], H_loc * D)
+        # row-sharded output projection -> psum over the model axis
+        o = jnp.einsum("btf,fe->bte", attn, lp["wo"].astype(cfg.dtype))
     o = jax.lax.psum(o.astype(jnp.float32), model_axis)
     x = x + o.astype(cfg.dtype)
 
@@ -303,6 +329,17 @@ class TransformerTrainer:
         n_model = mesh.shape["model"]
         self.n_data = mesh.shape["data"]
         cfg.validate(n_model)
+        if cfg.flash is None:
+            # auto: the Pallas kernel computes exact LOCAL attention, so
+            # it applies when the sequence is unsharded; the ring path
+            # owns the sequence-parallel case
+            from dataclasses import replace
+            cfg = replace(cfg, flash=(self.n_data == 1
+                                      and jax.default_backend() == "tpu"))
+        elif cfg.flash and self.n_data > 1:
+            raise ValueError(
+                "flash=True computes local attention only; a sequence "
+                "sharded over data axis > 1 needs the ring path")
         self.mesh, self.cfg, self.lr = mesh, cfg, learning_rate
         self.seed = seed
 
@@ -325,6 +362,20 @@ class TransformerTrainer:
             return params, loss
 
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
+
+        def train_steps(params, xs, ys):
+            """S steps in ONE dispatch (lax.scan over the leading step
+            axis of [S, B, T] token batches).  Besides fewer host round
+            trips, this amortises the tunnelled platform's flat
+            per-execution cost for programs containing Pallas kernels
+            (~0.2s/exec measured, scratch/prof_flash5.py) the same way
+            the MLP's fused epoch does."""
+            def body(p, xy):
+                p, loss = train_step(p, *xy)
+                return p, loss
+            return jax.lax.scan(body, params, (xs, ys))
+
+        self._train_steps = jax.jit(train_steps, donate_argnums=(0,))
         self._loss = jax.jit(loss_fn)
         self._pspecs = pspecs
 
@@ -337,9 +388,11 @@ class TransformerTrainer:
     def place_batch(self, tokens: np.ndarray
                     ) -> Tuple[jax.Array, jax.Array]:
         """[B, T+1] host tokens -> sequence-sharded (inputs, shifted
-        targets); T must divide by the data-axis size."""
-        x, y = tokens[:, :-1], tokens[:, 1:]
-        sh = NamedSharding(self.mesh, P(None, "data"))
+        targets); T must divide by the data-axis size.  A leading step
+        axis ([S, B, T+1], for :attr:`_train_steps`) rides along."""
+        x, y = tokens[..., :-1], tokens[..., 1:]
+        spec = P(None, "data") if tokens.ndim == 2 else P(None, None, "data")
+        sh = NamedSharding(self.mesh, spec)
         return jax.device_put(x, sh), jax.device_put(y, sh)
 
     def step(self, params: Params, tokens: np.ndarray):

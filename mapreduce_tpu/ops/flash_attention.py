@@ -1,0 +1,387 @@
+"""In-tree Pallas flash attention: the transformer's single-chip hot op.
+
+Why a hand-written kernel (the first Pallas use in this repo, and a
+measured one): the unchunked jnp attention materialises the [B, H, T, T]
+f32 score tensor in HBM — at the bench config (B4 H16 T2048) that is
+1.07GB *per layer* re-read across softmax passes, measured 9% of peak on
+v5e (scratch/prof_mfu.py); the lax.scan + jax.checkpoint flash tiling
+(parallel/ring.py block path) keeps memory bounded but pays scan
+overhead + full recompute, topping out at 34% step MFU
+(scratch/prof_mfu2.py).  A Pallas kernel holds each score tile in VMEM,
+never touching HBM with scores at all (measured: scratch/prof_flash3.py).
+
+Kernel layout is ``[B, H, T, D]`` (Mosaic tiling wants the sequence and
+head_dim in the last two block dims); the wrapper accepts the model's
+native ``[B, T, H, D]`` too and transposes, but the transformer feeds
+the kernel layout directly so no transpose is ever materialised.  The
+grid is ``(B, H, T/block_q, T/block_kv)`` — KV innermost, so the
+(m, den, acc) online-softmax state for one Q tile lives in VMEM scratch
+across KV steps while Pallas double-buffers the KV tile DMAs against the
+MXU.  Causal Q tiles skip above-diagonal KV tiles entirely — the index
+map redirects the skipped DMA to the next tile that will be needed (the
+shipped-kernel trick), so neither FLOPs nor bytes are wasted.  Score
+memory is O(block_q x block_kv) whatever T is, so the same kernel serves
+the 2048-token bench and the 32K long-context config.
+
+Backward is the standard two-pass flash recomputation (dQ pass over KV
+tiles, dKV pass over Q tiles) wired through ``jax.custom_vjp`` with
+(q, k, v, out, lse) residuals — activation memory O(B T H D), never
+O(T²).  lse/delta ride as ``[B, H, T, 1]`` so their tiles obey lane
+tiling without 128x replication.
+
+The reference has no analogue (its only notion of long inputs is
+streaming file iterators, utils.lua:133-200); this is the beyond-parity
+long-context family's hot op (SURVEY.md §7 "pallas kernels for the hot
+ops").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
+
+
+def _on_diag(iq, j, block_q, block_kv):
+    """Does KV tile j intersect or precede Q tile iq's causal row range?"""
+    return j * block_kv <= iq * block_q + block_q - 1
+
+
+def _pick_block(t: int, want: int) -> int:
+    """Largest block <= *want* that divides *t* and satisfies Mosaic's
+    sublane rule (multiple of 8, or the whole dimension).  Falls back to
+    the smallest valid divisor above *want* (worst case t itself, one
+    VMEM-resident tile) so ANY sequence length works — a T=640 config
+    that trained on the jnp path must not start raising here."""
+    if t <= want:
+        return t
+    for b in range(want, 7, -1):
+        if t % b == 0 and b % 8 == 0:
+            return b
+    for b in range(want + 1, t):
+        if t % b == 0 and (b % 8 == 0 or b == t):
+            return b
+    return t
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct inheriting *like*'s varying-mesh-axes set, so the
+    kernel composes with shard_map's vma checking (the kernel is purely
+    per-device: outputs vary exactly as its inputs do)."""
+    try:
+        vma = jax.typeof(like).vma
+    except AttributeError:  # pragma: no cover - older jax
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# -- forward -----------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, den_scr, acc_scr,
+                *, scale, causal, block_q, block_kv):
+    iq = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        den_scr[...] = jnp.zeros_like(den_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = iq * block_q
+    k_start = j * block_kv
+    needed = _on_diag(iq, j, block_q, block_kv) if causal else True
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0, 0]  # [block_q, D]
+        k = k_ref[0, 0]  # [block_kv, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qp = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kp = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(kp <= qp, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]                      # [block_q, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                      # masked cols -> 0
+        corr = jnp.exp(m_prev - m_new)
+        den = den_scr[:, 0:1] * corr + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [block_q, D]
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[:, 0:1] = m_new
+        den_scr[:, 0:1] = den
+
+    # emit every step (VMEM-resident until the Q-block index changes;
+    # only the final KV step's value reaches HBM)
+    den = jnp.maximum(den_scr[:, 0:1], 1e-30)
+    o_ref[0, 0] = (acc_scr[...] / den).astype(o_ref.dtype)
+    lse_ref[0, 0] = m_scr[:, 0:1] + jnp.log(den)
+
+
+# -- backward: dQ pass -------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_kv):
+    iq = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q_start = iq * block_q
+    k_start = j * block_kv
+    needed = _on_diag(iq, j, block_q, block_kv) if causal else True
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]             # [block_q, 1]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qp = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kp = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(kp <= qp, s, NEG_INF)
+        p = jnp.exp(s - lse)            # recomputed softmax tile
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)           # [block_q, block_kv] f32
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+# -- backward: dK/dV pass ----------------------------------------------------
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, block_q, block_kv):
+    jk = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_start = i * block_q
+    k_start = jk * block_kv
+    needed = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qp = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kp = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(kp <= qp, s, NEG_INF)
+        p = jnp.exp(s - lse)            # [block_q, block_kv]
+        # dV += P^T . dO   (contract over the q axis)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # dK += dS^T . Q
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# -- pallas_call wrappers ----------------------------------------------------
+
+
+def _q_index(b, h, i, j):
+    return (b, h, i, 0)
+
+
+def _make_kv_index(causal, block_q, block_kv, n_kv):
+    def kv_index(b, h, i, j):
+        if not causal:
+            return (b, h, j, 0)
+        # skipped (above-diagonal) tiles redirect their DMA to tile 0 —
+        # the first tile the NEXT Q block will need — so no bytes stream
+        # for tiles the kernel won't touch
+        return (b, h, jax.lax.select(
+            _on_diag(i, j, block_q, block_kv), j, 0), 0)
+    return kv_index
+
+
+def _fwd_call(q, k, v, cfgt):
+    causal, scale, block_q, block_kv, interpret = cfgt
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    n_q, n_kv = Tq // block_q, Tk // block_kv
+    kv_index = _make_kv_index(causal, block_q, block_kv, n_kv)
+    q_spec = pl.BlockSpec((1, 1, block_q, D), _q_index)
+    kv_spec = pl.BlockSpec((1, 1, block_kv, D), kv_index)
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), _q_index)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_kv),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, row_spec],
+        out_shape=[_sds(q.shape, q.dtype, q),
+                   _sds((B, H, Tq, 1), jnp.float32, q)],
+        scratch_shapes=[pltpu.VMEM((block_q, 128), jnp.float32),
+                        pltpu.VMEM((block_q, 128), jnp.float32),
+                        pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_call(q, k, v, out, lse, do, cfgt):
+    causal, scale, block_q, block_kv, interpret = cfgt
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    n_q, n_kv = Tq // block_q, Tk // block_kv
+    # delta[b,h,t] = sum_d dO * O — a tiny elementwise pass, jnp is fine
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B, H, Tq, 1]
+
+    kv_index = _make_kv_index(causal, block_q, block_kv, n_kv)
+    q_spec = pl.BlockSpec((1, 1, block_q, D), _q_index)
+    kv_spec = pl.BlockSpec((1, 1, block_kv, D), kv_index)
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), _q_index)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv),
+        grid=(B, H, n_q, n_kv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=_sds(q.shape, q.dtype, q),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dKV grid: KV tiles outer, Q tiles inner; causal skips tiles fully
+    # BELOW the needed range, redirecting to the last Q tile (always
+    # needed: it is on/after every diagonal)
+    def q_index2(b, h, j, i):
+        if not causal:
+            return (b, h, i, 0)
+        return (b, h, jax.lax.select(
+            i * block_q + block_q - 1 >= j * block_kv, i, n_q - 1), 0)
+
+    def kv_index2(b, h, j, i):
+        return (b, h, j, 0)
+
+    q_spec2 = pl.BlockSpec((1, 1, block_q, D), q_index2)
+    kv_spec2 = pl.BlockSpec((1, 1, block_kv, D), kv_index2)
+    row_spec2 = pl.BlockSpec((1, 1, block_q, 1), q_index2)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv),
+        grid=(B, H, n_kv, n_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[_sds(k.shape, k.dtype, k),
+                   _sds(v.shape, v.dtype, v)],
+        scratch_shapes=[pltpu.VMEM((block_kv, D), jnp.float32),
+                        pltpu.VMEM((block_kv, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, cfgt):
+    out, _ = _fwd_call(q, k, v, cfgt)
+    return out
+
+
+def _flash_fwd(q, k, v, cfgt):
+    out, lse = _fwd_call(q, k, v, cfgt)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(cfgt, res, do):
+    q, k, v, out, lse = res
+    return _bwd_call(q, k, v, out, lse, do, cfgt)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 512, block_kv: int = 512,
+                    layout: str = "bhtd",
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Tiled attention, differentiable; O(block²) score memory.
+
+    ``layout="bhtd"`` (kernel-native) or ``"bthd"`` (the ring path's
+    convention; transposed in and out).  ``interpret=None`` auto-selects
+    the Pallas interpreter off-TPU (the CPU test mesh) and the compiled
+    Mosaic kernel on TPU.  Block sizes shrink to T when T is smaller;
+    T must divide by the (shrunk) blocks.
+    """
+    if layout == "bthd":
+        q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+    elif layout != "bhtd":
+        raise ValueError(f"unknown layout {layout!r}")
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    block_q = _pick_block(Tq, block_q)
+    block_kv = _pick_block(Tk, block_kv)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfgt = (bool(causal), float(scale), int(block_q), int(block_kv),
+            bool(interpret))
+    out = _flash(q, k, v, cfgt)
+    if layout == "bthd":
+        out = jnp.swapaxes(out, 1, 2)
+    return out

@@ -232,7 +232,9 @@ class Server:
         chunks = ds.prepare(pairs, mesh)
         engine = self._get_device_engine(ds, mesh)
         timings: Dict[str, Any] = {}
-        res = engine.run(chunks, timings=timings)
+        # on_overflow="return" so the error names the MODULE knob (the
+        # engine's own raise points at EngineConfig generically)
+        res = engine.run(chunks, timings=timings, on_overflow="return")
         if res.overflow:
             raise RuntimeError(
                 f"device phase overflowed capacities by {res.overflow} "

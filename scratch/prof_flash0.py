@@ -1,0 +1,38 @@
+"""Smoke: does a Pallas kernel run on the axon platform, and what does
+the shipped TPU flash attention achieve at bench shapes (feasibility
+ceiling for an in-tree kernel)?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+B, T, H, D = 4, 2048, 16, 64
+q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+
+from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+
+def loss(q, k, v):
+    o = fa.flash_attention(q, k, v, causal=True, sm_scale=D ** -0.5)
+    return jnp.sum(o.astype(jnp.float32))
+
+
+g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+
+def run(n):
+    out = None
+    for _ in range(n):
+        out = g(q, k, v)
+    np.asarray(out[0]).ravel()[:1]
+
+
+run(3)
+t0 = time.time(); run(5); ts = time.time() - t0
+t0 = time.time(); run(20); tb = time.time() - t0
+sec = (tb - ts) / 15
+flops = 3 * 2 * 2 * B * H * T * T * D  # fwd+bwd, 2 matmuls (causal: /2 work)
+print(f"shipped flash fwd+bwd (1 layer): {sec*1e3:.2f} ms  "
+      f"({flops/sec/1e12:.1f} TF/s dense-equiv)", flush=True)

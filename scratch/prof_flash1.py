@@ -1,0 +1,59 @@
+"""Shipped flash attention: fwd-only vs bwd, block-size sweep."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+B, T, H, D = 4, 2048, 16, 64
+q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+
+from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+
+def slope(f, n=20):
+    out = None
+    for _ in range(3):
+        out = f()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    def run(n):
+        o = None
+        for _ in range(n):
+            o = f()
+        np.asarray(jax.tree.leaves(o)[0]).ravel()[:1]
+    t0 = time.time(); run(n // 4); ts = time.time() - t0
+    t0 = time.time(); run(n); tb = time.time() - t0
+    return (tb - ts) / (n - n // 4)
+
+
+fl = 2 * 2 * B * H * T * T * D
+
+fwd = jax.jit(lambda: fa.flash_attention(q, k, v, causal=True,
+                                         sm_scale=D ** -0.5))
+s = slope(fwd)
+print(f"fwd default blocks : {s*1e3:7.2f} ms ({fl/s/1e12:5.1f} TF/s)",
+      flush=True)
+
+for bq, bkv in [(512, 512), (256, 512), (512, 1024), (1024, 1024),
+                (256, 256)]:
+    bs = fa.BlockSizes(
+        block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bkv,
+        block_k_dkv=bkv, block_q_dkv=bq,
+        block_k_major_dq=bkv, block_k_dq=bkv, block_q_dq=bq,
+    )
+    f = jax.jit(lambda bs=bs: fa.flash_attention(
+        q, k, v, causal=True, sm_scale=D ** -0.5, block_sizes=bs))
+    s = slope(f)
+    print(f"fwd q{bq:4d}/kv{bkv:4d}  : {s*1e3:7.2f} ms "
+          f"({fl/s/1e12:5.1f} TF/s)", flush=True)
+
+    def lossf(q, k, v, bs=bs):
+        o = fa.flash_attention(q, k, v, causal=True, sm_scale=D ** -0.5,
+                               block_sizes=bs)
+        return jnp.sum(o.astype(jnp.float32))
+    g = jax.jit(jax.grad(lossf, argnums=(0, 1, 2)))
+    s = slope(lambda: g(q, k, v))
+    print(f"f+b q{bq:4d}/kv{bkv:4d}  : {s*1e3:7.2f} ms "
+          f"({3*fl/s/1e12:5.1f} TF/s)", flush=True)

@@ -1,0 +1,99 @@
+"""Device-side loop timing: N chained attention ops inside one jit.
+
+Immune to the tunnel's dispatch/readback noise — the difference between a
+20-iteration and a 4-iteration program is 16 iterations of pure device
+time."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from functools import partial
+
+B, T, H, D = 4, 2048, 16, 64
+q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+
+from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+fl = 2 * 2 * B * H * T * T * D  # fwd attention matmul flops (no causal /2)
+
+
+def timed(make_step, name, flops, n_hi=16, n_lo=4):
+    """make_step(x) -> x-like; chained under scan."""
+    def prog(n):
+        @jax.jit
+        def run(x):
+            def body(c, _):
+                return make_step(c), None
+            out, _ = jax.lax.scan(body, x, None, length=n)
+            return jnp.sum(out.astype(jnp.float32))
+        return run
+
+    hi, lo = prog(n_hi), prog(n_lo)
+    for f in (hi, lo):  # compile + warm
+        float(f(q))
+    ts = []
+    for _ in range(3):
+        t0 = time.time(); float(lo(q)); t_lo = time.time() - t0
+        t0 = time.time(); float(hi(q)); t_hi = time.time() - t0
+        ts.append((t_hi - t_lo) / (n_hi - n_lo))
+    s = min(ts)
+    print(f"{name:24s} {s*1e3:7.2f} ms ({flops/s/1e12:5.1f} TF/s)",
+          flush=True)
+    return s
+
+
+# jnp reference (what the model's unchunked path does)
+def jnp_attn(x):
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.einsum("bhqd,bhkd->bhqk", x, k,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v,
+                      preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+
+timed(jnp_attn, "jnp fwd", fl)
+
+
+def g_jnp(x):
+    return jax.grad(lambda q: jnp.sum(
+        jnp_attn_q(q).astype(jnp.float32)))(x).astype(jnp.bfloat16)
+
+
+def jnp_attn_q(qx):
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qx, k,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v,
+                      preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+
+timed(g_jnp, "jnp fwd+bwd(dq)", 3 * fl)
+
+bs = fa.BlockSizes(
+    block_q=512, block_k_major=512, block_k=512, block_b=1,
+    block_q_major_dkv=512, block_k_major_dkv=512,
+    block_k_dkv=512, block_q_dkv=512,
+    block_k_major_dq=512, block_k_dq=512, block_q_dq=512,
+)
+
+
+def pl_attn(x):
+    return fa.flash_attention(x, k, v, causal=True, sm_scale=D ** -0.5,
+                              block_sizes=bs)
+
+
+timed(pl_attn, "pallas fwd c512", fl)
+
+
+def g_pl(x):
+    return jax.grad(lambda q: jnp.sum(
+        pl_attn(q).astype(jnp.float32)))(x).astype(jnp.bfloat16)
+
+
+timed(g_pl, "pallas fwd+bwd(dq) c512", 3 * fl)

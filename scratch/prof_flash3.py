@@ -1,0 +1,97 @@
+"""Long-chain timing: 64 iterations per dispatch; constants ~1%."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+B, T, H, D = 4, 2048, 16, 64
+q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+
+from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+fl = 2 * 2 * B * H * T * T * D
+N = 64
+
+
+def timed(step, name, flops):
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            return step(c), None
+        out, _ = jax.lax.scan(body, x, None, length=N)
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(run(q))  # compile + warm
+    best = 1e9
+    for _ in range(3):
+        t0 = time.time()
+        float(run(q))
+        best = min(best, (time.time() - t0) / N)
+    print(f"{name:26s} {best*1e3:7.2f} ms ({flops/best/1e12:5.1f} TF/s)",
+          flush=True)
+
+
+def jnp_attn(x):
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.einsum("bhqd,bhkd->bhqk", x, k,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v,
+                      preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+
+timed(jnp_attn, "jnp fwd", fl)
+timed(lambda x: jax.grad(lambda qq: jnp.sum(
+    jnp_attn(qq).astype(jnp.float32)))(x).astype(jnp.bfloat16),
+    "jnp fwd+bwd(dq)", 3 * fl)
+
+
+bs = fa.BlockSizes(
+    block_q=512, block_k_major=512, block_k=512, block_b=1,
+    block_q_major_dkv=512, block_k_major_dkv=512,
+    block_k_dkv=512, block_q_dkv=512,
+    block_k_major_dq=512, block_k_dq=512, block_q_dq=512,
+)
+
+
+def pl_attn(x):
+    return fa.flash_attention(x, k, v, causal=True, sm_scale=D ** -0.5,
+                              block_sizes=bs)
+
+
+timed(pl_attn, "pallas fwd c512", fl)
+timed(lambda x: jax.grad(lambda qq: jnp.sum(
+    pl_attn(qq).astype(jnp.float32)))(x).astype(jnp.bfloat16),
+    "pallas fwd+bwd(dq) c512", 3 * fl)
+
+# grads wrt q, k AND v (the real training need)
+def g3(x):
+    dq, dk, dv = jax.grad(lambda a, b, c: jnp.sum(fa.flash_attention(
+        a, b, c, causal=True, sm_scale=D ** -0.5,
+        block_sizes=bs).astype(jnp.float32)), argnums=(0, 1, 2))(x, k, v)
+    return (dq + dk + dv).astype(jnp.bfloat16)
+
+
+timed(g3, "pallas fwd+bwd(dqkv)", 3 * fl)
+
+
+def g3j(x):
+    dq, dk, dv = jax.grad(lambda a, b, c: jnp.sum(
+        _attn3(a, b, c).astype(jnp.float32)), argnums=(0, 1, 2))(x, k, v)
+    return (dq + dk + dv).astype(jnp.bfloat16)
+
+
+def _attn3(qx, kx, vx):
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qx, kx,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, vx,
+                      preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+
+timed(g3j, "jnp fwd+bwd(dqkv)", 3 * fl)

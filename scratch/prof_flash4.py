@@ -1,0 +1,57 @@
+"""In-tree flash kernel vs shipped vs jnp at bench shapes (TPU)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+from mapreduce_tpu.ops.flash_attention import flash_attention
+
+B, T, H, D = 4, 2048, 16, 64
+q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+
+fl = 2 * 2 * B * H * T * T * D
+N = 64
+
+
+def timed(step, name, flops):
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            return step(c), None
+        out, _ = jax.lax.scan(body, x, None, length=N)
+        return jnp.sum(out.astype(jnp.float32))
+
+    t0 = time.time()
+    float(run(q))  # compile + warm
+    compile_s = time.time() - t0
+    best = 1e9
+    for _ in range(3):
+        t0 = time.time()
+        float(run(q))
+        best = min(best, (time.time() - t0) / N)
+    print(f"{name:30s} {best*1e3:7.2f} ms ({flops/best/1e12:5.1f} TF/s) "
+          f"[compile {compile_s:.0f}s]", flush=True)
+
+
+for bq, bkv in [(512, 512), (256, 512), (512, 1024), (1024, 512),
+                (2048, 512), (512, 2048)]:
+    def f(x, bq=bq, bkv=bkv):
+        return flash_attention(x, k, v, causal=True,
+                               block_q=bq, block_kv=bkv)
+    timed(f, f"flash fwd q{bq}/kv{bkv}", fl)
+
+    def g(x, bq=bq, bkv=bkv):
+        return jax.grad(lambda a: jnp.sum(flash_attention(
+            a, k, v, causal=True, block_q=bq,
+            block_kv=bkv).astype(jnp.float32)))(x).astype(jnp.bfloat16)
+    timed(g, f"flash f+b(dq-only) q{bq}/kv{bkv}", 3 * fl)
+
+    def g3(x, bq=bq, bkv=bkv):
+        dq, dk, dv = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+            a, b, c, causal=True, block_q=bq,
+            block_kv=bkv).astype(jnp.float32)),
+            argnums=(0, 1, 2))(x, k, v)
+        return (dq + dk + dv).astype(jnp.bfloat16)
+    timed(g3, f"flash f+b(dqkv) q{bq}/kv{bkv}", 3 * fl)

@@ -1,0 +1,170 @@
+"""Bisect the fwd kernel slowness: strip features one at a time."""
+import sys, time, functools
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, T, H, D = 4, 2048, 16, 64
+BQ = BKV = 512
+q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+fl = 2 * 2 * B * H * T * T * D
+
+
+def timed(f, name):
+    t0 = time.time()
+    out = f(q)
+    np.asarray(out).ravel()[:1]
+    comp = time.time() - t0
+    t0 = time.time()
+    for _ in range(10):
+        out = f(out)
+    np.asarray(out).ravel()[:1]
+    ms = (time.time() - t0) / 10 * 1e3
+    print(f"{name:34s} {ms:8.2f} ms ({fl/ms*1e3/1e12:5.1f} TF/s) "
+          f"[compile {comp:.0f}s]", flush=True)
+
+
+def qmap(b, h, i, j):
+    return (b, h, i, 0)
+
+
+def kvmap(b, h, i, j):
+    return (b, h, j, 0)
+
+
+def build(body, n_scr, causal_skip=False):
+    specs = dict(
+        grid=(B, H, T // BQ, T // BKV),
+        in_specs=[pl.BlockSpec((1, 1, BQ, D), qmap),
+                  pl.BlockSpec((1, 1, BKV, D), kvmap),
+                  pl.BlockSpec((1, 1, BKV, D), kvmap)],
+        out_specs=pl.BlockSpec((1, 1, BQ, D), qmap),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((BQ, 128), jnp.float32)
+                        for _ in range(n_scr - 1)]
+        + [pltpu.VMEM((BQ, D), jnp.float32)],
+    )
+    call = pl.pallas_call(body, **specs)
+    return jax.jit(lambda a: call(a, k, v))
+
+
+# V1: pure matmul-chain, no softmax, no state
+def v1(q_ref, k_ref, v_ref, o_ref, acc):
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    acc[...] += jax.lax.dot_general(s.astype(jnp.bfloat16), v_ref[0, 0],
+                                    (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    o_ref[0, 0] = acc[...].astype(o_ref.dtype)
+
+
+timed(build(v1, 1), "v1 matmuls+acc only")
+
+
+# V2: + online softmax state in full-width scratch (no partial stores)
+def v2(q_ref, k_ref, v_ref, o_ref, m_scr, d_scr, acc):
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        d_scr[...] = jnp.zeros_like(d_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * 0.125
+    m_prev = m_scr[:, 0:1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    den = d_scr[:, 0:1] * corr + p.sum(axis=-1, keepdims=True)
+    acc[...] = acc[...] * corr + jax.lax.dot_general(
+        p.astype(jnp.bfloat16), v_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:, 0:1] = m_new
+    d_scr[:, 0:1] = den
+    o_ref[0, 0] = (acc[...] / jnp.maximum(den, 1e-30)).astype(o_ref.dtype)
+
+
+timed(build(v2, 3), "v2 +online softmax")
+
+
+# V3: + causal mask iota/where (no skip)
+def v3(q_ref, k_ref, v_ref, o_ref, m_scr, d_scr, acc):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        d_scr[...] = jnp.zeros_like(d_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * 0.125
+    qp = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BKV), 0)
+    kp = j * BKV + jax.lax.broadcasted_iota(jnp.int32, (BQ, BKV), 1)
+    s = jnp.where(kp <= qp, s, -1e30)
+    m_prev = m_scr[:, 0:1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    den = d_scr[:, 0:1] * corr + p.sum(axis=-1, keepdims=True)
+    acc[...] = acc[...] * corr + jax.lax.dot_general(
+        p.astype(jnp.bfloat16), v_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:, 0:1] = m_new
+    d_scr[:, 0:1] = den
+    o_ref[0, 0] = (acc[...] / jnp.maximum(den, 1e-30)).astype(o_ref.dtype)
+
+
+timed(build(v3, 3), "v3 +causal mask")
+
+
+# V4: v3 + pl.when causal tile skip
+def v4(q_ref, k_ref, v_ref, o_ref, m_scr, d_scr, acc):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        d_scr[...] = jnp.zeros_like(d_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(j * BKV <= i * BQ + BQ - 1)
+    def _():
+        s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * 0.125
+        qp = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BKV), 0)
+        kp = j * BKV + jax.lax.broadcasted_iota(jnp.int32, (BQ, BKV), 1)
+        s = jnp.where(kp <= qp, s, -1e30)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        den = d_scr[:, 0:1] * corr + p.sum(axis=-1, keepdims=True)
+        acc[...] = acc[...] * corr + jax.lax.dot_general(
+            p.astype(jnp.bfloat16), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, 0:1] = m_new
+        d_scr[:, 0:1] = den
+
+    o_ref[0, 0] = (acc[...] / jnp.maximum(d_scr[:, 0:1], 1e-30)
+                   ).astype(o_ref.dtype)
+
+
+timed(build(v4, 3), "v4 +tile skip")

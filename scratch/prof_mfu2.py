@@ -1,0 +1,59 @@
+"""Full-step MFU under attention/loss chunking variants (bench config)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.models.transformer import (
+    TransformerConfig, TransformerTrainer)
+
+PEAK = 197e12
+mesh = make_mesh()
+B, T = 4, 2048 * mesh.shape["data"]
+
+
+def _run(step, n):
+    out = None
+    for _ in range(n):
+        out = step()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+
+
+def slope(step, n=20):
+    _run(step, 3)
+    t0 = time.time(); _run(step, n // 4); t_small = time.time() - t0
+    t0 = time.time(); _run(step, n); t_big = time.time() - t0
+    return (t_big - t_small) / (n - n // 4)
+
+
+def trial(name, **kw):
+    cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                            n_heads=16, head_dim=64, ffn=4096, **kw)
+    tr = TransformerTrainer(mesh, cfg, learning_rate=1e-3)
+    params = tr.init_params()
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab, size=(B, T + 1)).astype(np.int32)
+    x, y = tr.place_batch(toks)
+    state = {"p": params}
+
+    def step():
+        state["p"], loss = tr._train_step(state["p"], x, y)
+        return loss
+
+    sec = slope(step)
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree.leaves(state["p"]))
+    attn = 3 * 2 * 2 * B * cfg.n_heads * T * T * cfg.head_dim
+    flops = 6.0 * n_params * (B * T) + attn
+    print(f"{name:28s} {sec*1e3:8.2f} ms  mfu={flops/sec/PEAK*100:5.1f}%",
+          flush=True)
+
+
+trial("baseline (no chunking)")
+trial("attn_block=1024", attn_block=1024)
+trial("attn_block=512", attn_block=512)
+trial("attn_block=256", attn_block=256)
+trial("loss_block=1024", loss_block=1024)
+trial("attn1024+loss1024", attn_block=1024, loss_block=1024)
+trial("attn512+remat", attn_block=512, remat=True)
