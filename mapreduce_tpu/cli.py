@@ -251,9 +251,43 @@ def cmd_drop(argv: List[str]) -> int:
     return 0
 
 
+def cmd_warmup(argv: List[str]) -> int:
+    """Prime the persistent XLA compilation cache for the device engine
+    (cold compile is ~100s at bench shapes — the lax.sort comparator;
+    utils/compile_cache.py has the analysis).  Run once per machine /
+    config; afterwards every corpus size hits the warm cache because the
+    auto wave split is corpus-size-independent."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu warmup")
+    p.add_argument("--chunk-len", type=int, default=1 << 22)
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent cache location (default: package-"
+                        "adjacent .jax_cache, or $MAPREDUCE_TPU_CACHE)")
+    p.add_argument("--bench", action="store_true",
+                   help="use bench.py's engine capacities instead of the "
+                        "DeviceWordCount defaults")
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+
+    from .utils.compile_cache import enable_persistent_cache
+
+    path = enable_persistent_cache(args.cache_dir)
+
+    from .engine import DeviceWordCount
+    from .engine.wordcount import bench_engine_config
+    from .parallel import make_mesh
+
+    cfg = bench_engine_config() if args.bench else None
+    wc = DeviceWordCount(make_mesh(), chunk_len=args.chunk_len, config=cfg)
+    secs = wc.warm()
+    print(f"compiled engine programs in {secs:.1f}s -> cache at {path}")
+    return 0
+
+
 COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "wordcount": cmd_wordcount, "drop": cmd_drop,
-            "blobserver": cmd_blobserver, "docserver": cmd_docserver}
+            "blobserver": cmd_blobserver, "docserver": cmd_docserver,
+            "warmup": cmd_warmup}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

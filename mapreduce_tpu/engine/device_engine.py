@@ -103,11 +103,13 @@ class _WaveFeeder:
     """
 
     def __init__(self, engine: "DeviceEngine", chunks: np.ndarray,
-                 waves: int, prefetch: int = None) -> None:
+                 waves: int = None, prefetch: int = None,
+                 k: int = None) -> None:
         self._chunks = chunks
         S = chunks.shape[0]
         self.n_dev = engine.n_dev
-        k = -(-S // (waves * self.n_dev))  # chunks per device per wave
+        if k is None:  # explicit wave count (tests, user tuning)
+            k = -(-S // (waves * self.n_dev))  # chunks per device per wave
         self.rpw = k * self.n_dev          # rows per wave
         self.waves = -(-S // self.rpw)  # drop waves that would be all-pad
         self.S = S
@@ -120,10 +122,15 @@ class _WaveFeeder:
         self._submitted = 0
 
     @property
-    def n_real(self) -> np.int32:
-        """True chunk count; indices beyond it are padding whose records
-        the program masks out."""
-        return np.int32(self.S)
+    def n_real(self):
+        """True chunk count (a COMMITTED replicated device scalar, so the
+        jit compile key matches precompile's replicated aval); indices
+        beyond it are padding whose records the program masks out."""
+        if not hasattr(self, "_n_real"):
+            self._n_real = jax.device_put(
+                np.int32(self.S),
+                NamedSharding(self._sharding.mesh, P()))
+        return self._n_real
 
     def _put_wave(self, w: int):
         lo = w * self.rpw
@@ -339,14 +346,25 @@ class DeviceEngine:
     #: each wave's transfer ≈ its compute on the tunnelled v5e link
     WAVE_BYTES = 48 << 20
 
-    def _auto_waves(self, chunks: np.ndarray) -> int:
-        # no upper cap on the count: the streaming fold keeps peak HBM at
-        # ~STREAM_PREFETCH waves regardless of W, and the pairwise merge
-        # is shape-stable so W never costs another compile — wave SIZE
-        # staying ~WAVE_BYTES is what bounds memory as corpora grow
-        by_bytes = max(1, round(chunks.nbytes / self.WAVE_BYTES))
-        by_rows = max(1, chunks.shape[0] // self.n_dev)
-        return min(by_bytes, by_rows)
+    def _rows_per_wave(self, row_bytes: int) -> int:
+        """THE wave-size formula — precompile and the auto run path must
+        agree byte-for-byte or the primed persistent-cache entry is never
+        the one a run looks up."""
+        return max(1, round(self.WAVE_BYTES / max(1, row_bytes)))
+
+    def _auto_rows(self, chunks: np.ndarray) -> int:
+        """Chunks per device per wave for the auto path: a FIXED function
+        of the row byte size (not of the corpus), so the per-wave program
+        shape — and with it the persistent-cache entry — is identical for
+        every corpus larger than one wave.  Cold compile of the engine
+        programs is ~100s at bench shapes (the lax.sort comparator,
+        scratch/prof_compile*.py); shape-stable waves mean a machine pays
+        it once, not once per corpus size.  Streaming keeps peak HBM at
+        ~STREAM_PREFETCH waves whatever the resulting wave count; only
+        sub-wave inputs shrink k (tests, tiny corpora)."""
+        S = chunks.shape[0]
+        row_bytes = max(1, chunks.nbytes // max(1, S))
+        return min(self._rows_per_wave(row_bytes), -(-S // self.n_dev))
 
     def _multiprocess(self) -> bool:
         """True when the mesh spans devices of other JAX processes
@@ -423,27 +441,89 @@ class DeviceEngine:
                           if map_dropped else cfg.tile_records),
         )
 
+    def precompile(self, row_shape, row_dtype=np.uint8,
+                   k: int = None) -> float:
+        """AOT-compile the per-wave program and the wave-merge program at
+        the AUTO wave shape for rows of *row_shape*, returning the
+        seconds spent.  With ``jax.config.jax_compilation_cache_dir``
+        set, this populates XLA's persistent cache — cold compile is
+        ~100s at bench shapes (the lax.sort comparator dominates;
+        scratch/prof_compile*.py) and the auto wave split is
+        corpus-size-independent, so one warmup serves every future corpus
+        on the machine.  (bench.py runs this synchronously after
+        staging — compile RPCs and corpus transfers share the tunnel,
+        so overlapping them just serialises both.)"""
+        import time
+
+        t0 = time.time()
+        if k is None:
+            row_bytes = int(np.dtype(row_dtype).itemsize
+                            * np.prod(row_shape))
+            k = self._rows_per_wave(row_bytes)
+        cfg = self.config
+        # lower with the RUN path's shardings: the persistent-cache key
+        # covers input shardings, so an unsharded AOT lowering would
+        # prime entries the real jit dispatch never hits
+        row_sh = NamedSharding(self.mesh, P(AXIS))
+        rep = NamedSharding(self.mesh, P())
+        shapes = (
+            jax.ShapeDtypeStruct((k * self.n_dev,) + tuple(row_shape),
+                                 row_dtype, sharding=row_sh),
+            jax.ShapeDtypeStruct((k * self.n_dev,), np.int32,
+                                 sharding=row_sh),
+            jax.ShapeDtypeStruct((), np.int32, sharding=rep),
+        )
+        fn = self._get_compiled(cfg)
+        out_info = jax.eval_shape(fn, *shapes)
+        fn.lower(*shapes).compile()
+        # merge folds two per-partition unique sets: [n_dev, 2C, ...],
+        # sharded over the leading device axis like the wave outputs
+        merged = [jax.ShapeDtypeStruct(
+            (a.shape[0], 2 * a.shape[1]) + a.shape[2:], a.dtype,
+            sharding=NamedSharding(self.mesh, P(AXIS)))
+            for a in out_info[:4]]
+        self._get_merge(cfg).lower(*merged).compile()
+        return time.time() - t0
+
     def stage_inputs(self, chunks: np.ndarray, waves: int = None):
         """Issue and COMPLETE the host->device transfer of *chunks*,
         returning an opaque staged handle for :meth:`run`.
 
-        Exists because upload and compute can be legitimately decoupled:
-        a cold client's first transfers happen before any program has
-        executed (on the tunnelled dev platform that path measures
-        ~25-50x faster — see scratch/prof_poison3.py), and a user
+        Upload and compute can be legitimately decoupled: a user
         streaming a corpus can stage the next batch while deciding what
-        to run.  ``run(chunks, staged=...)`` then charges no upload.
+        to run, and a benchmark can separate ingress cost from pipeline
+        cost.  ``run(chunks, staged=...)`` then charges no upload.
+
+        Residency is VERIFIED, not assumed: on the tunnelled dev
+        platform ``jax.block_until_ready`` can return while the transfer
+        is still in flight (measured: block reports ~0.7s for a 307MB
+        stage whose bytes take ~23s to truly land), so this method runs
+        a checksum program over every staged buffer and fetches the
+        scalar — the return therefore means the bytes are on the device.
+        (Round 3's "pre-execution fast transfer path" was an artifact of
+        that early return; the link measures ~13MB/s in both execution
+        states, scratch/prof_ingress.py.)
 
         Unlike the streaming run path (bounded at ~STREAM_PREFETCH waves),
         a staged handle holds the WHOLE corpus in device memory — that is
         its point.  The handle is single-use: :meth:`run` consumes it,
         freeing each wave as soon as its program completes."""
-        W = self._auto_waves(chunks) if waves is None else max(1, waves)
-        feeder = _WaveFeeder(self, chunks, W)  # prefetch=all
+        if waves is None:
+            feeder = _WaveFeeder(self, chunks, k=self._auto_rows(chunks))
+        else:
+            feeder = _WaveFeeder(self, chunks, max(1, waves))
         resolved = [feeder.get(w) for w in range(feeder.waves)]
         n_real = feeder.n_real
         feeder.close()  # resolved list owns the references now
         jax.block_until_ready([a for pair in resolved for a in pair])
+        # residency barrier: a scalar depending on a slice of every
+        # staged buffer cannot be produced until the transfers finish
+        key = ("stage_barrier", len(resolved))
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(
+                lambda *cs: sum(jnp.sum(c[..., ::4096].astype(jnp.int32))
+                                for c in cs))
+        np.asarray(self._compiled[key](*[ci for ci, _ in resolved]))
         return resolved, n_real
 
     def run(self, chunks: np.ndarray, max_retries: int = 3,
@@ -500,13 +580,20 @@ class DeviceEngine:
                     "single-use: each wave is freed as it is folded); "
                     "stage_inputs again for another run")
             pairs = {w: staged_list[w] for w in range(W)}
+            # remember the handle's per-wave row split so a capacity
+            # retry re-uploads at the SAME program shape (no recompile)
+            staged_k = staged_list[0][0].shape[0] // self.n_dev
             # consume the handle: freeing below must work even while the
             # caller still holds it
             staged_list.clear()
         else:
-            W = self._auto_waves(chunks) if waves is None else max(1, waves)
-            feeder = _WaveFeeder(self, chunks, W,
-                                 prefetch=self.STREAM_PREFETCH)
+            if waves is None:
+                feeder = _WaveFeeder(self, chunks,
+                                     k=self._auto_rows(chunks),
+                                     prefetch=self.STREAM_PREFETCH)
+            else:
+                feeder = _WaveFeeder(self, chunks, max(1, waves),
+                                     prefetch=self.STREAM_PREFETCH)
             W = feeder.waves  # clamped to data-bearing waves
             n_real = feeder.n_real
 
@@ -589,7 +676,7 @@ class DeviceEngine:
                             "chunks were passed; call run(chunks, "
                             "staged=handle) with the handle's source "
                             "array")
-                    feeder = _WaveFeeder(self, chunks, W,
+                    feeder = _WaveFeeder(self, chunks, k=staged_k,
                                          prefetch=self.STREAM_PREFETCH)
                     pairs = None
                 else:

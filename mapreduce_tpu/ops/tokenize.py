@@ -214,10 +214,18 @@ def word_hashes_host(text: bytes) -> dict:
 
 
 def shard_text(data: bytes, num_shards: int,
-               pad_multiple: int = 128, return_offsets: bool = False):
+               pad_multiple: int = 128, return_offsets: bool = False,
+               pad_to: int = None):
     """Host prep: split a text blob into ``num_shards`` roughly equal byte
     chunks on whitespace boundaries, space-padded to one common static
     length (multiple of *pad_multiple* for TPU lane alignment).
+
+    ``pad_to`` fixes the padded length L to a caller-chosen value
+    (still rounded to *pad_multiple*; raised if a span genuinely
+    exceeds it): callers that compile shape-specialised programs pass a
+    corpus-INDEPENDENT target so every corpus hits one compiled program
+    / one persistent-cache entry, instead of a data-dependent max-span
+    length that recompiles per corpus size.
 
     Returns ``(chunks [S, L] uint8, L)`` — or, with *return_offsets*,
     ``(chunks, L, starts [S] int64)`` where ``starts[i]`` is chunk *i*'s
@@ -237,6 +245,8 @@ def shard_text(data: bytes, num_shards: int,
         bounds.append(cut)
     bounds.append(n)
     L = max(1, max(bounds[i + 1] - bounds[i] for i in range(num_shards)))
+    if pad_to is not None:
+        L = max(L, pad_to)
     L = ((L + pad_multiple - 1) // pad_multiple) * pad_multiple
     arr = np.full((num_shards, L), ord(" "), dtype=np.uint8)
     for i in range(num_shards):

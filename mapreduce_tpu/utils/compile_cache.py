@@ -1,0 +1,37 @@
+"""Persistent XLA compilation cache setup, shared by bench.py and the
+``warmup`` CLI.
+
+Cold compile of the device engine's programs is ~100s at bench shapes —
+NOT a tunnel artifact: CPU and TPU backends compile them in the same time
+(scratch/prof_compile.py), and the cost is pinned on the ``lax.sort``
+comparator, scaling with num_keys x operand count (prof_compile3.py:
+11s for 1 key/1 operand at 524k rows, 42s for 2 keys/5 operands; 70s at
+11M rows).  The unrolled Hillis-Steele ladders round 3 blamed compile in
+1-2s.  A two-pass stable-argsort alternative compiles 3x faster but RUNS
+2.6x slower end to end (4.7s vs 1.8s compute — the 11M-row permutation
+gathers; prof_sortab.py + a full bench A/B), so the variadic sort stays
+and the cache carries the one-time cost instead: the engine's auto wave
+split is corpus-size-independent, so one warm cache entry serves every
+corpus on the machine.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: default cache location: alongside the repo/package installation
+DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".jax_cache")
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> str:
+    """Point XLA's persistent compilation cache at *path* (default: the
+    package-adjacent ``.jax_cache``).  Idempotent; returns the path."""
+    import jax
+
+    path = path or os.environ.get("MAPREDUCE_TPU_CACHE", DEFAULT_DIR)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path

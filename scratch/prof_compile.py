@@ -1,0 +1,66 @@
+"""Attribute the bench warmup's cold-compile time (VERDICT r3 #7).
+
+Times .lower() (trace -> StableHLO) and .compile() (XLA/Mosaic) for each
+program the bench warmup builds, at the exact bench shapes, on whatever
+backend JAX_PLATFORMS selects — run once under the TPU tunnel and once
+with JAX_PLATFORMS=cpu to split 'HLO is huge' from 'remote service is
+slow'.
+"""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+
+from mapreduce_tpu.engine import DeviceWordCount, EngineConfig
+from mapreduce_tpu.engine.wordcount import shard_text
+from mapreduce_tpu.parallel import make_mesh
+
+mesh = make_mesh()
+wc = DeviceWordCount(
+    mesh, chunk_len=1 << 22,
+    config=EngineConfig(local_capacity=1 << 18,
+                        exchange_capacity=1 << 17,
+                        out_capacity=1 << 18,
+                        tile=512, tile_records=104))
+
+# bench corpus: 307MB -> 6 waves; reproduce the wave shape cheaply
+n_bytes = 322_000_000
+n_chunks = -(-n_bytes // (1 << 22))
+eng = wc._engine_for(1 << 22)
+n_chunks = -(-n_chunks // eng.n_dev) * eng.n_dev
+fake = np.zeros((n_chunks, 1 << 22), np.uint8)
+W = eng._auto_waves(fake)
+k = -(-n_chunks // (W * eng.n_dev))
+print(f"chunks={n_chunks} waves={W} chunks/dev/wave={k}", flush=True)
+
+cfg = eng.config
+fn = eng._program(cfg)
+chunks_shape = jax.ShapeDtypeStruct((k * eng.n_dev, 1 << 22), jnp_u8 :=
+                                    np.uint8)
+idx_shape = jax.ShapeDtypeStruct((k * eng.n_dev,), np.int32)
+n_shape = jax.ShapeDtypeStruct((), np.int32)
+
+t0 = time.time()
+lowered = fn.lower(chunks_shape, idx_shape, n_shape)
+t_lower = time.time() - t0
+t0 = time.time()
+lowered.compile()
+t_compile = time.time() - t0
+print(f"main program : lower {t_lower:.1f}s  compile {t_compile:.1f}s",
+      flush=True)
+
+merge = eng._merge_program(cfg)
+C = cfg.out_capacity
+P = eng.n_dev
+km = jax.ShapeDtypeStruct((P, 2 * C, 2), np.uint32)
+vm = jax.ShapeDtypeStruct((P, 2 * C), np.int32)
+pm = jax.ShapeDtypeStruct((P, 2 * C, 2), np.int32)
+am = jax.ShapeDtypeStruct((P, 2 * C), bool)
+t0 = time.time()
+lm = merge.lower(km, vm, pm, am)
+t_lower = time.time() - t0
+t0 = time.time()
+lm.compile()
+t_compile = time.time() - t0
+print(f"merge program: lower {t_lower:.1f}s  compile {t_compile:.1f}s",
+      flush=True)

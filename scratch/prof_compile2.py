@@ -1,0 +1,44 @@
+"""Which op dominates XLA compile time in the engine programs? (CPU —
+compile cost measured identical to TPU, scratch/prof_compile.py)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+from mapreduce_tpu.ops.segscan import (ladder_cumsum, ladder_cummax,
+                                       segmented_scan,
+                                       sorted_unique_reduce)
+
+N_BIG = 11_075_584     # main program record rows (13 chunks x 851,968)
+N_MERGE = 524_288      # merge rows (2 x out_capacity)
+CAP = 1 << 18
+
+
+def t_compile(fn, *shapes, name=""):
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in shapes]
+    t0 = time.time()
+    lowered = jax.jit(fn).lower(*args)
+    tl = time.time() - t0
+    t0 = time.time()
+    lowered.compile()
+    tc = time.time() - t0
+    print(f"{name:34s} lower {tl:5.1f}s compile {tc:6.1f}s", flush=True)
+
+
+for N in (N_MERGE, N_BIG):
+    tag = f"N={N//1000}k"
+    t_compile(lambda x: ladder_cumsum(x), ((N,), np.int32),
+              name=f"ladder_cumsum {tag}")
+    t_compile(lambda x: ladder_cummax(x), ((N,), np.int32),
+              name=f"ladder_cummax {tag}")
+    t_compile(lambda k1, k2, v: jax.lax.sort((k1, k2, v), num_keys=2),
+              ((N,), np.uint32), ((N,), np.uint32), ((N,), np.int32),
+              name=f"variadic sort x3 {tag}")
+    t_compile(lambda e: jnp.searchsorted(
+        ladder_cumsum(e.astype(np.int32)),
+        jnp.arange(1, CAP + 1, dtype=np.int32), side="left"),
+        ((N,), bool), name=f"cumsum+searchsorted {tag}")
+    t_compile(lambda k, v, p, m: sorted_unique_reduce(
+        k, v, p, m, CAP, "sum", unit_values=True),
+        ((N, 2), np.uint32), ((N,), np.int32), ((N, 2), np.int32),
+        ((N,), bool), name=f"sorted_unique_reduce {tag}")

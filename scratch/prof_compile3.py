@@ -1,0 +1,41 @@
+"""lax.sort compile-time scaling: num_keys x operand count (CPU)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+N = 524_288
+
+
+def t_compile(fn, shapes, name):
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in shapes]
+    t0 = time.time()
+    c = jax.jit(fn).lower(*args).compile()
+    print(f"{name:44s} compile {time.time()-t0:6.1f}s", flush=True)
+
+
+u32 = np.uint32
+i32 = np.int32
+
+t_compile(lambda a: jax.lax.sort((a,), num_keys=1)[0],
+          [((N,), u32)], "1 key, 1 operand")
+t_compile(lambda a, b: jax.lax.sort((a, b), num_keys=1),
+          [((N,), u32), ((N,), i32)], "1 key, 2 operands")
+t_compile(lambda a, b, c: jax.lax.sort((a, b, c), num_keys=1),
+          [((N,), u32), ((N,), i32), ((N,), i32)], "1 key, 3 operands")
+t_compile(lambda a, b: jax.lax.sort((a, b), num_keys=2),
+          [((N,), u32), ((N,), u32)], "2 keys, 2 operands")
+t_compile(lambda a, b, c: jax.lax.sort((a, b, c), num_keys=2),
+          [((N,), u32), ((N,), u32), ((N,), i32)], "2 keys, 3 operands")
+t_compile(lambda a, b, c, d, e: jax.lax.sort((a, b, c, d, e), num_keys=2),
+          [((N,), u32), ((N,), u32), ((N,), i32), ((N,), i32),
+           ((N,), i32)], "2 keys, 5 operands")
+# two-pass stable single-key lexicographic equivalent
+t_compile(lambda a, b, c: jax.lax.sort(
+    jax.lax.sort((b, a, c), num_keys=1), num_keys=1),
+    [((N,), u32), ((N,), u32), ((N,), i32)],
+    "two-pass stable 1-key (lexicographic)")
+# argsort + gather
+t_compile(lambda a, b, c: tuple(
+    x[jnp.argsort(a, stable=True)] for x in (a, b, c)),
+    [((N,), u32), ((N,), u32), ((N,), i32)], "argsort + 3 gathers")

@@ -1,0 +1,53 @@
+"""True host->device ingress rate, pre- vs post-execution (round 4).
+
+Round 3 believed the tunnel served a "cold client's" pre-execution
+transfers 25-50x faster than post-execution ones.  That was an artifact:
+jax.block_until_ready returns while transfers are still in flight on
+this platform, so staging "completed" in 0.7s while the bytes kept
+trickling.  Forcing residency with a checksum program (a scalar that
+cannot exist until every staged buffer landed) shows the truth:
+
+    stage+forced residency (copy 1, pre-exec):  23.1s
+    stage+forced residency (copy 2, post-exec): 22.5s
+    checksum alone (resident):                   0.11s
+
+i.e. ~13MB/s in BOTH execution states — there is no fast path and no
+demotion; there is one slow tunnel.  Consequence: bench.py reports
+ingress separately (with a residency barrier in stage_inputs) and times
+the pipeline from verified-resident HBM, matching the reference's clock
+(its corpus pre-exists in cluster storage).
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+from mapreduce_tpu.utils.compile_cache import enable_persistent_cache
+enable_persistent_cache()
+import jax, jax.numpy as jnp
+import numpy as np
+from bench import make_corpus
+from mapreduce_tpu.engine import DeviceWordCount
+from mapreduce_tpu.engine.wordcount import bench_engine_config
+from mapreduce_tpu.parallel import make_mesh
+
+corpus = make_corpus(49_158_635, 1_965_734)
+wc = DeviceWordCount(make_mesh(), chunk_len=1 << 22,
+                     config=bench_engine_config())
+
+chk = jax.jit(lambda *cs: sum(jnp.sum(c[:, ::4096].astype(jnp.int32))
+                              for c in cs))
+
+t0 = time.time()
+h1 = wc.stage(corpus)   # includes the residency barrier now
+print(f"stage (verified, copy 1): {time.time()-t0:.2f}s", flush=True)
+
+t0 = time.time()
+counts = wc.count_staged(h1)
+print(f"count_staged: {time.time()-t0:.2f}s, {len(counts)} uniques",
+      flush=True)
+
+t0 = time.time()
+h2 = wc.stage(corpus)
+print(f"stage (verified, copy 2, post-exec): {time.time()-t0:.2f}s",
+      flush=True)
+t0 = time.time()
+int(np.asarray(chk(*[ci for ci, _ in h2[2][0]])))
+print(f"checksum alone (resident): {time.time()-t0:.2f}s", flush=True)

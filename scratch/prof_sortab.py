@@ -1,0 +1,50 @@
+"""A/B: variadic 2-key sort vs two-pass stable argsort + gathers, at the
+bench's record-buffer shape, runtime AND compile (TPU)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+N = 11_075_584
+u32, i32 = np.uint32, np.int32
+
+rng = np.random.default_rng(0)
+k1 = rng.integers(0, 1 << 32, size=N, dtype=np.uint64).astype(u32)
+k2 = rng.integers(0, 1 << 32, size=N, dtype=np.uint64).astype(u32)
+p1 = rng.integers(0, 1 << 30, size=N).astype(i32)
+p2 = rng.integers(0, 1 << 30, size=N).astype(i32)
+
+
+def variadic(k1, k2, p1, p2):
+    out = jax.lax.sort((k1, k2, p1, p2), num_keys=2)
+    return tuple(out)
+
+
+def twopass(k1, k2, p1, p2):
+    perm2 = jnp.argsort(k2, stable=True)
+    perm = perm2[jnp.argsort(k1[perm2], stable=True)]
+    return k1[perm], k2[perm], p1[perm], p2[perm]
+
+
+def run(fn, name):
+    t0 = time.time()
+    j = jax.jit(fn)
+    o = j(k1, k2, p1, p2)
+    jax.block_until_ready(o)
+    comp = time.time() - t0
+    best = 1e9
+    for _ in range(5):
+        t0 = time.time()
+        o = j(k1, k2, p1, p2)
+        np.asarray(o[0]).ravel()[:1]
+        best = min(best, time.time() - t0)
+    print(f"{name:12s} compile+1st {comp:6.1f}s  run {best*1e3:7.1f} ms",
+          flush=True)
+    return o
+
+
+a = run(variadic, "variadic")
+b = run(twopass, "twopass")
+for x, y in zip(a, b):
+    assert np.array_equal(np.asarray(x), np.asarray(y)), "MISMATCH"
+print("results identical", flush=True)
