@@ -356,7 +356,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 512, block_kv: int = 512,
+                    block_q: int = 1024, block_kv: int = 1024,
                     layout: str = "bhtd",
                     interpret: Optional[bool] = None) -> jax.Array:
     """Tiled attention, differentiable; O(block²) score memory.
