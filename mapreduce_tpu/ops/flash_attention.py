@@ -279,7 +279,7 @@ def _fwd_call(q, k, v, cfgt):
     return out, lse
 
 
-def _bwd_call(q, k, v, out, lse, do, cfgt):
+def _bwd_call(q, k, v, out, lse, do, cfgt, dlse=None):
     causal, scale, block_q, block_kv, interpret = cfgt
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
@@ -287,6 +287,10 @@ def _bwd_call(q, k, v, out, lse, do, cfgt):
     # delta[b,h,t] = sum_d dO * O — a tiny elementwise pass, jnp is fine
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B, H, Tq, 1]
+    if dlse is not None:
+        # lse cotangent: ds += p * dlse == running the same kernels with
+        # delta - dlse (see _flash_lse_bwd)
+        delta = delta - dlse.astype(jnp.float32)
 
     kv_index = _make_kv_index(causal, block_q, block_kv, n_kv)
     q_spec = pl.BlockSpec((1, 1, block_q, D), _q_index)
@@ -336,22 +340,53 @@ def _bwd_call(q, k, v, out, lse, do, cfgt):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, cfgt):
-    out, _ = _fwd_call(q, k, v, cfgt)
-    return out
+def _flash_lse(q, k, v, cfgt):
+    return _fwd_call(q, k, v, cfgt)
 
 
-def _flash_fwd(q, k, v, cfgt):
+def _flash_lse_fwd(q, k, v, cfgt):
     out, lse = _fwd_call(q, k, v, cfgt)
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd(cfgt, res, do):
+def _flash_lse_bwd(cfgt, res, cots):
+    """Backward with BOTH cotangents: the lse cotangent folds into the
+    delta term — d(lse)/ds is the softmax row p, so ds picks up p*dlse,
+    i.e. the kernels run unchanged with delta' = delta - dlse.  (dv has
+    no lse term: lse is independent of V.)  flash_attention discards
+    lse, so its dlse arrives as zeros and the fold is a no-op there."""
     q, k, v, out, lse = res
-    return _bwd_call(q, k, v, out, lse, do, cfgt)
+    do, dlse = cots
+    return _bwd_call(q, k, v, out, lse, do, cfgt, dlse=dlse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _make_cfgt(q, k, causal, scale, block_q, block_kv, interpret):
+    D = q.shape[3]
+    if scale is None:
+        scale = D ** -0.5
+    block_q = _pick_block(q.shape[2], block_q)
+    block_kv = _pick_block(k.shape[2], block_kv)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return (bool(causal), float(scale), int(block_q), int(block_kv),
+            bool(interpret))
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True,
+                        scale: Optional[float] = None,
+                        block_q: int = 1024, block_kv: int = 1024,
+                        interpret: Optional[bool] = None):
+    """Kernel-layout (``[B, H, T, D]``) attention returning
+    ``(out, lse [B, H, T, 1] f32)`` — the partial-softmax form ring
+    attention needs to combine per-ring-step results across devices
+    (parallel/ring.py); fully differentiable including through uses of
+    lse.  Same tiling/auto-shrink rules as :func:`flash_attention`."""
+    cfgt = _make_cfgt(q, k, causal, scale, block_q, block_kv, interpret)
+    return _flash_lse(q, k, v, cfgt)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -371,17 +406,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
     elif layout != "bhtd":
         raise ValueError(f"unknown layout {layout!r}")
-    B, H, Tq, D = q.shape
-    Tk = k.shape[2]
-    if scale is None:
-        scale = D ** -0.5
-    block_q = _pick_block(Tq, block_q)
-    block_kv = _pick_block(Tk, block_kv)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    cfgt = (bool(causal), float(scale), int(block_q), int(block_kv),
-            bool(interpret))
-    out = _flash(q, k, v, cfgt)
+    cfgt = _make_cfgt(q, k, causal, scale, block_q, block_kv, interpret)
+    out, _ = _flash_lse(q, k, v, cfgt)
     if layout == "bthd":
         out = jnp.swapaxes(out, 1, 2)
     return out

@@ -62,10 +62,73 @@ def _combine(m, den, num, bm, bden, bnum):
     return new_m, den, num
 
 
+def _ring_attention_flash(q, k, v, axis_name, causal, scale,
+                          interpret=None):
+    """Ring attention with the Pallas kernel as each step's local
+    compute (ops/flash_attention.py).  A ring step sees KV from rank
+    ``src = rank - s``: blocks BEFORE mine are fully unmasked (plain
+    attention), my own block is standard causal, blocks AFTER mine are
+    fully masked — so the three cases dispatch to the existing kernel
+    via ``lax.cond`` (causal=False / causal=True / skip) and no
+    offset-masking kernel variant is needed.  Per-step partials combine
+    in (out, lse) log-sum-exp form; the kernel's custom vjp carries the
+    lse cotangent, so the whole ring differentiates.
+
+    Layout: converts to the kernel's [B, H, T, D] at the boundary and
+    rotates K/V in that layout (same bytes over ICI)."""
+    from ..ops.flash_attention import NEG_INF, flash_attention_lse
+
+    P = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    qk = jnp.swapaxes(q, 1, 2)  # [B, H, T, D]
+    kk = jnp.swapaxes(k, 1, 2)
+    vk = jnp.swapaxes(v, 1, 2)
+    B, H, T, D = qk.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    def attend(kv_causal, k_blk, v_blk):
+        o, l = flash_attention_lse(qk, k_blk, v_blk, causal=kv_causal,
+                                   scale=scale, interpret=interpret)
+        return o.astype(jnp.float32), l
+
+    def step(carry, s):
+        k_blk, v_blk, out, lse = carry
+        src = (rank - s) % P
+        if causal:
+            o_s, l_s = jax.lax.cond(
+                src == rank,
+                lambda: attend(True, k_blk, v_blk),
+                lambda: jax.lax.cond(
+                    src < rank,
+                    lambda: attend(False, k_blk, v_blk),
+                    # fully-masked step: contributes nothing
+                    lambda: (jnp.zeros_like(out),
+                             jnp.full_like(lse, NEG_INF))))
+        else:
+            o_s, l_s = attend(False, k_blk, v_blk)
+        new_lse = jnp.logaddexp(lse, l_s)
+        w_old = jnp.exp(lse - new_lse)
+        w_new = jnp.exp(l_s - new_lse)
+        out = out * w_old + o_s * w_new
+        perm = [(i, (i + 1) % P) for i in range(P)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, out, new_lse), None
+
+    # accumulators derived from q inherit its vma (same trick as the jnp
+    # path); lse at NEG_INF with out zeros combines to zeros, no NaN
+    out0 = qk.astype(jnp.float32) * 0.0
+    lse0 = (qk[..., :1].astype(jnp.float32) * 0.0) + NEG_INF
+    (k_f, v_f, out, lse), _ = jax.lax.scan(
+        step, (kk, vk, out0, lse0), jnp.arange(P))
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, causal: bool = True,
                    scale: Optional[float] = None,
-                   block_size: Optional[int] = None) -> jax.Array:
+                   block_size: Optional[int] = None,
+                   use_flash: Optional[bool] = None) -> jax.Array:
     """Exact multi-head attention over a sequence sharded on *axis_name*.
 
     ``q/k/v``: [B, T_local, H, D] local blocks (must run inside
@@ -80,7 +143,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     the cross-device half).  Must divide T_local; None = one chunk
     (exact same math either way: the online-softmax combine is
     associative).
+
+    ``use_flash`` (None = auto: on TPU) runs each ring step's local
+    attention through the Pallas kernel instead of the jnp path — the
+    kernel already tiles, so ``block_size`` is ignored there.
     """
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale)
     P = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     B, T, H, D = q.shape

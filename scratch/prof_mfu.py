@@ -150,8 +150,10 @@ def mm_loss(x, wqkv, wo, w_in, w_out):
     return jnp.sum(x.astype(jnp.float32))
 
 
-mm_g = jax.jit(jax.grad(mm_loss))
-sec = timeit(lambda: mm_g(xin, wqkv, wo, w_in, w_out))
+# grad wrt ALL args: grad-wrt-x-only let XLA drop the weight-gradient
+# matmuls entirely (first measurement read an impossible 199% of peak)
+mm_g = jax.jit(jax.grad(mm_loss, argnums=(0, 1, 2, 3, 4)))
+sec = timeit(lambda: mm_g(xin, wqkv, wo, w_in, w_out)[0])
 mm_flops = 6 * cfg.n_layers * B * T * (E * 3 * H * D + H * D * E + 2 * E * F)
 report("mm_chain", sec, mm_flops)
 
