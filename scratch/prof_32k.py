@@ -1,0 +1,97 @@
+"""Attribute the 32K-context step (1.37s measured, ~0.24s ideal)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+PEAK = 197e12
+B, T, H, D, E, F, V = 1, 32768, 16, 64, 1024, 4096, 32768
+
+from mapreduce_tpu.ops.flash_attention import flash_attention
+
+q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+
+
+def slope(f, n=12):
+    out = None
+    for _ in range(3):
+        out = f()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.time()
+    for _ in range(n // 4):
+        out = f()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    ts = time.time() - t0
+    t0 = time.time()
+    for _ in range(n):
+        out = f()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    tb = time.time() - t0
+    return (tb - ts) / (n - n // 4)
+
+
+fl_attn = 8 * 3 * 2 * 2 * B * H * T * T * D  # 8 layers, fwd+bwd
+
+
+def attn8(x):
+    o = x
+    for _ in range(8):
+        o = flash_attention(o, k, v, causal=True)
+    return o
+
+
+g = jax.jit(lambda x: jax.grad(lambda a: jnp.sum(attn8(a).astype(
+    jnp.float32)))(x).astype(jnp.bfloat16))
+sec = slope(lambda: g(q))
+print(f"attn x8 fwd+bwd(dq): {sec*1e3:7.1f} ms "
+      f"({fl_attn/sec/1e12:5.1f} TF/s dense-equiv; causal useful = half)",
+      flush=True)
+
+# loss head at 32K with loss_block scan
+xin = jax.random.normal(jax.random.key(3), (B, T, E), jnp.bfloat16)
+unemb = jax.random.normal(jax.random.key(4), (E, V), jnp.bfloat16)
+tgt = jnp.asarray(np.random.default_rng(0).integers(0, V, (B, T)),
+                  jnp.int32)
+
+
+def head(x, w, t, Tc=2048):
+    C = T // Tc
+    xs = jnp.moveaxis(x.reshape(B, C, Tc, E), 1, 0)
+    ts = jnp.moveaxis(t.reshape(B, C, Tc), 1, 0)
+
+    def chunk(_, xt):
+        x_c, t_c = xt
+        logits = jnp.einsum("bte,ev->btv", x_c, w,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return None, (lse - tl)
+
+    body = jax.checkpoint(chunk)
+    _, nll = jax.lax.scan(body, None, (xs, ts))
+    return jnp.mean(nll)
+
+
+hg = jax.jit(jax.grad(head, argnums=(0, 1)))
+sec = slope(lambda: hg(xin, unemb, tgt)[0])
+print(f"loss head (scan):    {sec*1e3:7.1f} ms "
+      f"({6*B*T*E*V/sec/1e12:5.1f} TF/s)", flush=True)
+
+# ffn/qkv matmul chain at 32K
+w_in = jax.random.normal(jax.random.key(5), (E, F), jnp.bfloat16)
+w_out = jax.random.normal(jax.random.key(6), (F, E), jnp.bfloat16)
+
+
+def mm(x, w_in, w_out):
+    for _ in range(8):
+        u = jax.nn.gelu(jnp.einsum("bte,ef->btf", x, w_in))
+        x = x + jnp.einsum("btf,fe->bte", u, w_out)
+    return jnp.sum(x.astype(jnp.float32))
+
+
+mg = jax.jit(jax.grad(mm, argnums=(0, 1, 2)))
+sec = slope(lambda: mg(xin, w_in, w_out)[0])
+print(f"ffn x8 fwd+bwd:      {sec*1e3:7.1f} ms "
+      f"({6*8*B*T*2*E*F/sec/1e12:5.1f} TF/s)", flush=True)
