@@ -39,6 +39,13 @@ def _add_verbosity(p: argparse.ArgumentParser) -> None:
                    help="-v info, -vv debug")
 
 
+def _add_auth(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--auth", default=None, metavar="TOKEN",
+                   help="shared-secret bearer token for the networked "
+                        "planes (default: $MAPREDUCE_TPU_AUTH; can also "
+                        "ride the connstr as http://TOKEN@HOST:PORT)")
+
+
 def _setup_logging(verbose: int) -> None:
     level = (logging.WARNING, logging.INFO, logging.DEBUG)[min(verbose, 2)]
     logging.basicConfig(
@@ -61,6 +68,7 @@ def cmd_server(argv: List[str]) -> int:
     p.add_argument("--init-args", default=None,
                    help="JSON passed to every module init()")
     p.add_argument("--result-ns", default=None)
+    _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -83,7 +91,7 @@ def cmd_server(argv: List[str]) -> int:
         params["init_args"] = json.loads(args.init_args)
     if args.result_ns:
         params["result_ns"] = args.result_ns
-    server = Server(args.connstr, args.dbname)
+    server = Server(args.connstr, args.dbname, auth=args.auth)
     server.configure(params)
     stats = server.loop()
     print(json.dumps(stats, default=float))
@@ -99,6 +107,7 @@ def cmd_worker(argv: List[str]) -> int:
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--max-sleep", type=float, default=None)
     p.add_argument("--max-tasks", type=int, default=None)
+    _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -110,12 +119,13 @@ def cmd_worker(argv: List[str]) -> int:
                               ("max_tasks", args.max_tasks))
             if v is not None}
     if args.workers == 1:
-        w = Worker(args.connstr, args.dbname)
+        w = Worker(args.connstr, args.dbname, auth=args.auth)
         w.configure(conf)
         w.execute()
     else:
         threads = spawn_worker_threads(args.connstr, args.dbname,
-                                       args.workers, conf=conf)
+                                       args.workers, conf=conf,
+                                       auth=args.auth)
         for t in threads:
             t.join()
     return 0
@@ -175,13 +185,15 @@ def cmd_blobserver(argv: List[str]) -> int:
     p.add_argument("root", help="directory to store blobs in")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8750)
+    _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
 
     from .storage import BlobServer
 
-    srv = BlobServer(args.root, args.host, args.port)
+    srv = BlobServer(args.root, args.host, args.port,
+                     auth_token=args.auth)
     print(f"serving {args.root} at http:{srv.address} "
           f"(storage DSL: \"http:HOST:{srv.port}\")", flush=True)
     try:
@@ -202,6 +214,7 @@ def cmd_docserver(argv: List[str]) -> int:
     p.add_argument("--root", default=None,
                    help="back the board with dir://ROOT (durable) "
                         "instead of in-memory")
+    _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -210,7 +223,7 @@ def cmd_docserver(argv: List[str]) -> int:
     from .coord.docstore import DirDocStore
 
     store = DirDocStore(args.root) if args.root else None
-    srv = DocServer(store, args.host, args.port)
+    srv = DocServer(store, args.host, args.port, auth_token=args.auth)
     print(f"job board at http://{srv.host}:{srv.port} "
           f"(CONNSTR: \"http://HOST:{srv.port}\")", flush=True)
     try:
@@ -228,13 +241,14 @@ def cmd_drop(argv: List[str]) -> int:
     p.add_argument("dbname")
     p.add_argument("--storage", default=None,
                    help="also clear this storage backend")
+    _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
 
     from .coord import docstore
 
-    store = docstore.connect(args.connstr)
+    store = docstore.connect(args.connstr, auth=args.auth)
     dropped = 0
     for coll in store.collections():
         if coll == args.dbname or coll.startswith(args.dbname + "."):
@@ -244,7 +258,7 @@ def cmd_drop(argv: List[str]) -> int:
     if args.storage:
         from . import storage as storage_mod
 
-        st = storage_mod.router(args.storage)
+        st = storage_mod.router(args.storage, auth=args.auth)
         n = len(st.list())
         st.clear()
         print(f"cleared {n} blobs from {args.storage!r}")

@@ -21,12 +21,17 @@ from .docstore import DocStore
 class Connection:
     """A named database (collection-name prefix) over a :class:`DocStore`.
 
-    Reference: ``cnn(connstr, dbname, auth)`` (cnn.lua:106-113).  ``auth``
-    is accepted for API parity and ignored — there is no remote server.
+    Reference: ``cnn(connstr, dbname, auth)`` (cnn.lua:106-113); there
+    ``auth`` is a ``{user=..., password=...}`` table re-applied on every
+    reconnect (cnn.lua:34-39).  Here it is the shared-secret bearer token
+    for the networked backends (docserver/blobserver) — pass a plain
+    token string, or a reference-shaped dict whose ``password`` (or
+    ``token``) field is used.  Ignored by the in-process/dir backends,
+    which have no wire to guard.
     """
 
     def __init__(self, connstr: str, dbname: str,
-                 auth: Optional[Dict[str, str]] = None) -> None:
+                 auth: Optional[Any] = None) -> None:
         self.connstr = connstr
         self.dbname = dbname
         self.auth = auth
@@ -34,12 +39,37 @@ class Connection:
         # pending batched inserts: coll -> list of (doc, callback)
         self._pending: Dict[str, List[tuple]] = {}
 
+    def auth_token(self) -> Optional[str]:
+        """The bearer token in whatever shape it arrived: the ``auth``
+        param (str, or a reference-shaped dict), else embedded in the
+        connstr (``http://TOKEN@HOST:PORT``) — so a connstr-carried token
+        reaches the storage plane too, not just the board socket."""
+        from ..utils.httpclient import split_embedded_token
+
+        if isinstance(self.auth, dict):
+            return self.auth.get("password") or self.auth.get("token")
+        if self.auth:
+            return self.auth
+        if self.connstr.startswith("http://"):
+            return split_embedded_token(
+                self.connstr[len("http://"):])[0]
+        return None
+
+    def board_hostport(self) -> Optional[str]:
+        """``HOST:PORT`` of an http:// board connstr (ambient-auth scope)."""
+        from ..utils.httpclient import split_embedded_token
+
+        if self.connstr.startswith("http://"):
+            return split_embedded_token(self.connstr[len("http://"):])[1]
+        return None
+
     # -- connection -----------------------------------------------------
 
     def connect(self) -> DocStore:
-        """Reference: cnn.lua:34-39 (cached connection, auto-reconnect)."""
+        """Reference: cnn.lua:34-39 (cached connection, auth on connect)."""
         if self._store is None:
-            self._store = docstore.connect(self.connstr)
+            self._store = docstore.connect(self.connstr,
+                                           auth=self.auth_token())
         return self._store
 
     def ns(self, coll: str) -> str:

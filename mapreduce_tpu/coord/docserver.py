@@ -35,7 +35,7 @@ import threading
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
-from ..utils.httpclient import KeepAliveClient
+from ..utils.httpclient import KeepAliveClient, check_auth, default_auth_token
 from .docstore import Doc, DocStore, MemoryDocStore, Query
 
 # ops whose second application would change state: answered once, replayed
@@ -53,6 +53,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     done: "collections.OrderedDict[str, bytes]"   # rid -> recorded response
     inflight: Dict[str, threading.Event]          # rid -> original executing
     dedupe_lock: threading.Lock
+    auth_token: Optional[str]  # None = open server
 
     def log_message(self, *a):  # quiet
         pass
@@ -68,6 +69,13 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         if self.path != "/rpc":
             return self._respond(404, b"{}")
         length = int(self.headers.get("Content-Length", 0))
+        if not check_auth(self.auth_token, self.headers):
+            # drain the body first so the keep-alive stream stays in sync
+            self.rfile.read(length)
+            return self._respond(401, json.dumps(
+                {"ok": False, "type": "PermissionError",
+                 "error": "auth required (bad or missing bearer token)"}
+            ).encode())
         try:
             req = json.loads(self.rfile.read(length))
             op = req["op"]
@@ -162,12 +170,14 @@ class DocServer:
     """
 
     def __init__(self, store: Optional[DocStore] = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_token: Optional[str] = None) -> None:
         handler = type("BoundRpcHandler", (_RpcHandler,), {
             "store": store if store is not None else MemoryDocStore(),
             "done": collections.OrderedDict(),
             "inflight": {},
             "dedupe_lock": threading.Lock(),
+            "auth_token": default_auth_token(auth_token, ambient=False),
         })
         self.store = handler.store
         self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
@@ -203,9 +213,10 @@ class HttpDocStore(DocStore):
     exactly-once for mutating ops.
     """
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str,
+                 auth_token: Optional[str] = None) -> None:
         self._client = KeepAliveClient.from_address(
-            address, what="http docstore")
+            address, what="http docstore", auth_token=auth_token)
         self.host, self.port = self._client.host, self._client.port
 
     def _rpc(self, op: str, **fields: Any) -> Any:
@@ -216,13 +227,19 @@ class HttpDocStore(DocStore):
         status, raw = self._client.request(
             "POST", "/rpc", body=body,
             headers={"Content-Type": "application/json"})
+        if status == 401:
+            raise PermissionError(
+                f"docstore rpc {op!r}: auth rejected by "
+                f"{self.host}:{self.port} (set $MAPREDUCE_TPU_AUTH or "
+                "pass auth to Connection)")
         if status != 200:
             raise IOError(f"docstore rpc {op!r}: HTTP {status}")
         reply = json.loads(raw)
         if not reply.get("ok"):
             exc_type = {"ValueError": ValueError, "KeyError": KeyError,
-                        "TypeError": TypeError}.get(reply.get("type"),
-                                                    IOError)
+                        "TypeError": TypeError,
+                        "PermissionError": PermissionError,
+                        }.get(reply.get("type"), IOError)
             raise exc_type(reply.get("error", "rpc failed"))
         return reply["result"]
 

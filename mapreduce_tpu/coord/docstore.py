@@ -468,16 +468,19 @@ class _DirLock:
         return False
 
 
-def connect(connstr: str) -> DocStore:
+def connect(connstr: str, auth: Optional[str] = None) -> DocStore:
     """Open a store from a connection string (reference: a mongod host:port,
     utils.lua:62-69).  Forms:
 
       * ``mem://<name>``       — process-local named MemoryDocStore
       * ``dir:///path``        — DirDocStore rooted at /path
       * ``/abs/path``          — shorthand for dir://
-      * ``http://HOST:PORT``   — HttpDocStore dialing a DocServer (the
-        cross-host topology: any worker anywhere joins with one connstr,
-        like the reference's workers dialing one mongod)
+      * ``http://[TOKEN@]HOST:PORT`` — HttpDocStore dialing a DocServer
+        (the cross-host topology: any worker anywhere joins with one
+        connstr, like the reference's workers dialing one mongod).
+        ``auth`` is the bearer token for an auth-required server
+        (reference: the ``auth_table`` arg of cnn.lua:106-113); it can
+        also ride the connstr or $MAPREDUCE_TPU_AUTH (httpclient.py).
     """
     if connstr.startswith("mem://"):
         return MemoryDocStore.named(connstr[len("mem://"):])
@@ -485,7 +488,7 @@ def connect(connstr: str) -> DocStore:
         return DirDocStore(connstr[len("dir://"):])
     if connstr.startswith("http://"):
         from .docserver import HttpDocStore
-        return HttpDocStore(connstr[len("http://"):])
+        return HttpDocStore(connstr[len("http://"):], auth_token=auth)
     if connstr.startswith("/"):
         return DirDocStore(connstr)
     raise ValueError(

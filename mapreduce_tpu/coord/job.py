@@ -55,6 +55,23 @@ def map_results_prefix(path: str) -> str:
     return f"{path}/map_results"
 
 
+def ambient_scope(connection: Connection, storage_dsl) -> set:
+    """The ``HOST:PORT`` endpoints a job's ambient auth token is valid
+    for: its own board and its own http storage — nothing else, so user
+    fns dialing third-party HTTP hosts cannot leak the cluster secret."""
+    from ..utils.httpclient import split_embedded_token
+
+    hosts = set()
+    hp = connection.board_hostport()
+    if hp:
+        hosts.add(hp)
+    # parse the DSL prefix directly: get_storage_from would mkdtemp as a
+    # side effect for a bare "shared" string
+    if isinstance(storage_dsl, str) and storage_dsl.startswith("http:"):
+        hosts.add(split_embedded_token(storage_dsl.partition(":")[2])[1])
+    return hosts
+
+
 class Job:
     """Reference: ``job(cnn, job_tbl, task_status, fname, init_args, ...)``
     (job.lua:300-381); instances are built by the worker from a claimed
@@ -68,7 +85,8 @@ class Job:
         self.task_status = task_status
         self.task_tbl = task_tbl
         self.jobs_ns = jobs_ns
-        self._storage = storage_mod.router(task_tbl["storage"])
+        self._storage = storage_mod.router(task_tbl["storage"],
+                                           auth=connection.auth_token())
         self.path = task_tbl["path"]
         #: files consumed by a reduce run, deleted only once WRITTEN is
         #: durable (a re-run of a crashed reduce must still find them)
@@ -135,14 +153,27 @@ class Job:
     # -- execution ---------------------------------------------------------
 
     def execute(self) -> None:
-        """job:__call dispatch (job.lua:345-381)."""
+        """job:__call dispatch (job.lua:345-381).  Runs under the ambient
+        auth token — scoped to this job's own board + storage endpoints —
+        so user map/reduce fns that build their own storage handle
+        (router(DSL) in module code, e.g. examples/train_digits) inherit
+        the worker's --auth without env/DSL plumbing."""
+        from ..utils.httpclient import push_ambient_auth, restore_ambient_auth
+
         t_cpu, t_real = time.process_time(), time.time()
-        if self.task_status == TASK_STATUS.MAP:
-            self._execute_map()
-        elif self.task_status == TASK_STATUS.REDUCE:
-            self._execute_reduce()
-        else:
-            raise RuntimeError(f"job in task status {self.task_status}")
+        prev_auth = push_ambient_auth(
+            self._cnn.auth_token(),
+            ambient_scope(self._cnn, self.task_tbl.get("storage")))
+        try:
+            if self.task_status == TASK_STATUS.MAP:
+                self._execute_map()
+            elif self.task_status == TASK_STATUS.REDUCE:
+                self._execute_reduce()
+            else:
+                raise RuntimeError(
+                    f"job in task status {self.task_status}")
+        finally:
+            restore_ambient_auth(prev_auth)
         owned = self.mark_as_written(time.process_time() - t_cpu,
                                      time.time() - t_real)
         # delete consumed map files only once WRITTEN is durable AND this

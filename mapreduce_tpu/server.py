@@ -40,7 +40,7 @@ class Server:
     """Reference: ``server.new(connstr, dbname, auth)`` (server.lua:614-622)."""
 
     def __init__(self, connstr: str, dbname: str,
-                 auth: Optional[Dict[str, str]] = None,
+                 auth: Optional[Any] = None,
                  job_lease: Optional[float] = None) -> None:
         self.cnn = Connection(connstr, dbname, auth)
         self.task = Task(self.cnn, **(
@@ -156,7 +156,8 @@ class Server:
     # -- reduce planning (server.lua:279-329) ------------------------------
 
     def _prepare_reduce(self) -> int:
-        storage = storage_mod.router(self.params["storage"])
+        storage = storage_mod.router(self.params["storage"],
+                                     auth=self.cnn.auth_token())
         ns = map_results_prefix(self.params["path"])
         # group map result files by partition token P<nnnnn>
         # (server.lua:291-312)
@@ -247,7 +248,8 @@ class Server:
         # result partitions from a crashed (possibly host-plane) run are
         # cleared first — _result_pairs merges every result.P* file, so a
         # leftover P00001 would silently blend into the device output
-        storage = storage_mod.router(self.params["storage"])
+        storage = storage_mod.router(self.params["storage"],
+                                     auth=self.cnn.auth_token())
         storage.remove_many(self._result_partitions(storage))
         b = storage.builder()
         for key, values in sorted(out_pairs,
@@ -332,7 +334,8 @@ class Server:
         return merge_iterator([records(n) for n in names])
 
     def _final(self) -> Any:
-        storage = storage_mod.router(self.params["storage"])
+        storage = storage_mod.router(self.params["storage"],
+                                     auth=self.cnn.auth_token())
         finalfn = spec.load_role(self.params["finalfn"], "finalfn")
         reply = finalfn.fn(self._result_pairs(storage))
         if reply not in (True, False, None, "loop"):
@@ -359,6 +362,22 @@ class Server:
 
     def loop(self) -> Dict[str, Any]:
         assert self.configured, "call configure() before loop()"
+        # ambient token for user fns run server-side (taskfn/finalfn may
+        # build their own storage handle, like worker-side map fns do);
+        # scoped to this task's own endpoints and restored after — a
+        # later open server on this thread must not inherit it
+        from .coord.job import ambient_scope
+        from .utils.httpclient import push_ambient_auth, restore_ambient_auth
+
+        prev_auth = push_ambient_auth(
+            self.cnn.auth_token(),
+            ambient_scope(self.cnn, self.params.get("storage")))
+        try:
+            return self._loop_impl()
+        finally:
+            restore_ambient_auth(prev_auth)
+
+    def _loop_impl(self) -> Dict[str, Any]:
         it = 0
         skip_map = False
         # the execution plane is decided ONCE: params, falling back to the

@@ -23,7 +23,7 @@ import threading
 import urllib.parse
 from typing import Iterator, List, Optional, Tuple
 
-from ..utils.httpclient import KeepAliveClient
+from ..utils.httpclient import KeepAliveClient, check_auth, default_auth_token
 from .base import Storage
 from .localdir import LocalDirStorage
 
@@ -31,9 +31,20 @@ from .localdir import LocalDirStorage
 class _Handler(http.server.BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     store: LocalDirStorage  # set by BlobServer
+    auth_token: Optional[str]  # None = open server
 
     def log_message(self, *a):  # quiet
         pass
+
+    def _authed(self, body_length: int = 0) -> bool:
+        """Bearer-token gate (httpclient.check_auth); drains *body_length*
+        request bytes on rejection so the keep-alive stream stays usable."""
+        if check_auth(self.auth_token, self.headers):
+            return True
+        if body_length:
+            self.rfile.read(body_length)
+        self._respond(401)
+        return False
 
     def _name(self) -> Optional[str]:
         if not self.path.startswith("/blobs/"):
@@ -47,6 +58,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:
+        if not self._authed():
+            return
         if self.path == "/list":
             # names are quoted per line: arbitrary blob names (including
             # embedded newlines) must round-trip like the other backends
@@ -83,6 +96,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self._respond(200, content.encode())
 
     def do_HEAD(self) -> None:
+        if not self._authed():
+            return
         name = self._name()
         code = 200 if (name is not None
                        and self.store.exists(name)) else 404
@@ -91,15 +106,19 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_PUT(self) -> None:
+        length = int(self.headers.get("Content-Length", 0))
+        if not self._authed(body_length=length):
+            return
         name = self._name()
         if name is None:
             return self._respond(400)
-        length = int(self.headers.get("Content-Length", 0))
         content = self.rfile.read(length).decode()
         self.store.write(name, content)  # tempfile+rename: atomic
         self._respond(201)
 
     def do_DELETE(self) -> None:
+        if not self._authed():
+            return
         name = self._name()
         if name is None:
             return self._respond(400)
@@ -111,9 +130,11 @@ class BlobServer:
     """Serve a LocalDirStorage root over HTTP (threaded, stdlib)."""
 
     def __init__(self, root: str, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, auth_token: Optional[str] = None) -> None:
         handler = type("BoundHandler", (_Handler,),
-                       {"store": LocalDirStorage(root)})
+                       {"store": LocalDirStorage(root),
+                        "auth_token": default_auth_token(auth_token,
+                                                         ambient=False)})
         self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
@@ -141,9 +162,10 @@ class BlobServer:
 class HttpStorage(Storage):
     scheme = "http"
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str,
+                 auth_token: Optional[str] = None) -> None:
         self._client = KeepAliveClient.from_address(
-            address, what="http storage")
+            address, what="http storage", auth_token=auth_token)
         self.host, self.port = self._client.host, self._client.port
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None,
@@ -154,7 +176,14 @@ class HttpStorage(Storage):
         content atomically and DELETE converges.  A future non-idempotent
         endpoint must not ride this path — give it request-id dedupe like
         the docserver's mutating RPCs (coord/docserver.py)."""
-        return self._client.request(method, path, body=body, headers=headers)
+        status, body_out = self._client.request(method, path, body=body,
+                                                headers=headers)
+        if status == 401:
+            raise PermissionError(
+                f"blob {method} {path}: auth rejected by "
+                f"{self.host}:{self.port} (set $MAPREDUCE_TPU_AUTH or use "
+                "http:TOKEN@HOST:PORT)")
+        return status, body_out
 
     def _blob_path(self, name: str) -> str:
         return "/blobs/" + urllib.parse.quote(name, safe="")

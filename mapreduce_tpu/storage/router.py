@@ -44,12 +44,20 @@ def get_storage_from(storage: str = None) -> Tuple[str, str]:
     return backend, path
 
 
-def router(storage: str = None) -> Storage:
-    """Open the backend named by a DSL string (fs.router, fs.lua:185-208)."""
+def router(storage: str = None, auth: str = None) -> Storage:
+    """Open the backend named by a DSL string (fs.router, fs.lua:185-208).
+
+    ``auth`` is the bearer token for an auth-required blobserver behind
+    ``http:`` (ignored by the local backends); it can also be embedded as
+    ``http:TOKEN@HOST:PORT`` or come from $MAPREDUCE_TPU_AUTH — but note
+    the DSL string is persisted verbatim in the shared task document on
+    the job board, so an embedded token is visible to anything that can
+    read the board.  Prefer the env var or the explicit param for
+    deployments (utils/httpclient.py has the full precedence story)."""
     backend, path = get_storage_from(storage)
     if backend == "mem":
         return MemoryStorage.named(path)
     if backend == "http":
         from .httpstore import HttpStorage
-        return HttpStorage(path)
+        return HttpStorage(path, auth_token=auth)
     return LocalDirStorage(path)

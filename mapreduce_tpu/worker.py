@@ -29,7 +29,7 @@ class Worker:
     """Reference: ``worker.new(connstr, dbname, auth)`` (worker.lua:154-167)."""
 
     def __init__(self, connstr: str, dbname: str,
-                 auth: Optional[Dict[str, str]] = None,
+                 auth: Optional[Any] = None,
                  name: Optional[str] = None) -> None:
         self.cnn = Connection(connstr, dbname, auth)
         self.task = Task(self.cnn)
@@ -137,13 +137,14 @@ class Worker:
 
 def spawn_worker_threads(connstr: str, dbname: str, n: int,
                          conf: Optional[Dict[str, Any]] = None,
+                         auth: Optional[Any] = None,
                          ) -> List[threading.Thread]:
     """Run *n* workers as daemon threads in this process — the rebuild's
     'fake cluster' for tests and the single-host deployment (the reference
     uses N OS processes under ``screen``, test.sh:10)."""
     threads = []
     for i in range(n):
-        w = Worker(connstr, dbname, name=f"w{i}")
+        w = Worker(connstr, dbname, auth=auth, name=f"w{i}")
         if conf:
             w.configure(conf)
         t = threading.Thread(target=w.execute, daemon=True,

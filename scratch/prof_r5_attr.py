@@ -1,0 +1,139 @@
+"""Round-5 32K attribution: is attention MXU-geometry-bound at D=64?
+
+Hypothesis: QK^T ([bq,64]x[64,bkv]) and PV ([bq,bkv]x[bkv,64]) both use
+half the 128x128 MXU when head_dim=64, and flash bwd executes 9
+tile-matmuls vs the 6 the MFU formula counts (s recomputed in both dq
+and dkv passes) -> attention ceiling = 0.5 * (6/9) = 33% of causal
+useful peak, which is exactly the measured 0.8s.  If true, H=8/D=128
+(same E, same params, same counted FLOPs) doubles the ceiling.
+"""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault("MAPREDUCE_TPU_CACHE", "/root/repo/.jax_cache")
+import numpy as np
+import jax, jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+PEAK = 197e12
+B, T, E, F, V = 1, 32768, 1024, 4096, 32768
+
+from mapreduce_tpu.ops.flash_attention import flash_attention
+
+
+def slope(f, n=12):
+    out = None
+    for _ in range(3):
+        out = f()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.time()
+    for _ in range(n // 4):
+        out = f()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    ts = time.time() - t0
+    t0 = time.time()
+    for _ in range(n):
+        out = f()
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    tb = time.time() - t0
+    return (tb - ts) / (n - n // 4)
+
+
+def attn_case(H, D, fwd_only=False, n_rep=8):
+    q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+
+    def chain(x):
+        o = x
+        for _ in range(n_rep):
+            o = flash_attention(o, k, v, causal=True)
+        return o
+
+    if fwd_only:
+        g = jax.jit(chain)
+    else:
+        g = jax.jit(lambda x: jax.grad(lambda a: jnp.sum(chain(a).astype(
+            jnp.float32)))(x).astype(jnp.bfloat16))
+    sec = slope(lambda: g(q))
+    # counted dense-equiv FLOPs (the MFU formula's convention)
+    mm = 2 if fwd_only else 6
+    fl = mm * n_rep * 2 * B * H * T * T * D
+    useful = fl / 2  # causal
+    print(f"attn H={H:3d} D={D:3d} {'fwd    ' if fwd_only else 'fwd+bwd'}"
+          f" x{n_rep}: {sec*1e3:7.1f} ms  "
+          f"dense {fl/sec/1e12:6.1f} TF/s  useful {useful/sec/1e12:6.1f}"
+          f" TF/s ({useful/sec/PEAK*100:4.1f}% peak)", flush=True)
+    return sec
+
+
+for fwd_only in (True, False):
+    attn_case(16, 64, fwd_only)
+    attn_case(8, 128, fwd_only)
+
+# dense part: ffn chain at 32K
+xin = jax.random.normal(jax.random.key(3), (B, T, E), jnp.bfloat16)
+w_in = jax.random.normal(jax.random.key(5), (E, F), jnp.bfloat16)
+w_out = jax.random.normal(jax.random.key(6), (F, E), jnp.bfloat16)
+
+
+def mm8(x, w_in, w_out):
+    for _ in range(8):
+        u = jax.nn.gelu(jnp.einsum("bte,ef->btf", x, w_in))
+        x = x + jnp.einsum("btf,fe->bte", u, w_out)
+    return jnp.sum(x.astype(jnp.float32))
+
+
+mg = jax.jit(jax.grad(mm8, argnums=(0, 1, 2)))
+sec = slope(lambda: mg(xin, w_in, w_out)[0])
+fl = 6 * 8 * B * T * 2 * E * F
+print(f"ffn x8 fwd+bwd:      {sec*1e3:7.1f} ms ({fl/sec/1e12:5.1f} TF/s, "
+      f"{fl/sec/PEAK*100:4.1f}% peak)", flush=True)
+
+# qkv+proj chain (E x E-ish matmuls: 4 * E*HD per layer)
+wq = jax.random.normal(jax.random.key(7), (E, E), jnp.bfloat16)
+
+
+def qk8(x, w):
+    for _ in range(32):  # 8 layers x 4 projections
+        x = x + jnp.einsum("bte,ef->btf", x, w) * 0.01
+    return jnp.sum(x.astype(jnp.float32))
+
+
+qg = jax.jit(jax.grad(qk8, argnums=(0, 1)))
+sec = slope(lambda: qg(xin, wq)[0])
+fl = 6 * 32 * B * T * E * E
+print(f"proj x32 fwd+bwd:    {sec*1e3:7.1f} ms ({fl/sec/1e12:5.1f} TF/s, "
+      f"{fl/sec/PEAK*100:4.1f}% peak)", flush=True)
+
+# loss head at 32K with loss_block scan (as the model runs it)
+unemb = jax.random.normal(jax.random.key(4), (E, V), jnp.bfloat16)
+tgt = jnp.asarray(np.random.default_rng(0).integers(0, V, (B, T)),
+                  jnp.int32)
+
+
+def head(x, w, t, Tc=2048):
+    C = T // Tc
+    xs = jnp.moveaxis(x.reshape(B, C, Tc, E), 1, 0)
+    ts = jnp.moveaxis(t.reshape(B, C, Tc), 1, 0)
+
+    def chunk(_, xt):
+        x_c, t_c = xt
+        logits = jnp.einsum("bte,ev->btv", x_c, w,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return None, (lse - tl)
+
+    body = jax.checkpoint(chunk)
+    _, nll = jax.lax.scan(body, None, (xs, ts))
+    return jnp.mean(nll)
+
+
+hg = jax.jit(jax.grad(head, argnums=(0, 1)))
+sec = slope(lambda: hg(xin, unemb, tgt)[0])
+fl = 6 * B * T * E * V  # checkpointed: +2 recompute fwd -> 8/6 executed
+print(f"loss head (scan):    {sec*1e3:7.1f} ms ({fl/sec/1e12:5.1f} TF/s "
+      f"counted, {fl*8/6/sec/1e12:5.1f} executed, "
+      f"{fl/sec/PEAK*100:4.1f}% peak)", flush=True)

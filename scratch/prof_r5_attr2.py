@@ -1,0 +1,146 @@
+"""Round-5 32K attribution, device-side scan edition.
+
+prof_r5_attr.py's per-call slope timing produced negative times and >100%
+peak over the tunnel (async dispatch artifacts — round-4 note: per-call
+timing is useless here).  This version puts the repetition INSIDE the
+program with lax.scan, so one dispatch + one readback times N dependent
+iterations; slope between N and N//3 cancels dispatch + readback.
+"""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+PEAK = 197e12
+B, T, E, F, V = 1, 32768, 1024, 4096, 32768
+
+from mapreduce_tpu.ops.flash_attention import flash_attention
+
+
+def timed_scan(make_step, x0, n_hi=24, n_lo=8, what="", flops_per_iter=0.0,
+               useful_frac=1.0):
+    """Time a dependent chain of make_step applied n times inside scan."""
+    def run(n):
+        @jax.jit
+        def prog(x):
+            def body(c, _):
+                return make_step(c), None
+            y, _ = jax.lax.scan(body, x, None, length=n)
+            return y
+        r = prog(x0)          # compile + warm
+        np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        best = np.inf
+        for _ in range(3):
+            t0 = time.time()
+            r = prog(x0)
+            np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+            best = min(best, time.time() - t0)
+        return best
+    t_hi, t_lo = run(n_hi), run(n_lo)
+    sec = (t_hi - t_lo) / (n_hi - n_lo)
+    fl = flops_per_iter
+    useful = fl * useful_frac
+    print(f"{what:28s}: {sec*1e3:8.2f} ms/iter  dense {fl/sec/1e12:6.1f}"
+          f" TF/s  useful {useful/sec/1e12:6.1f} TF/s "
+          f"({useful/sec/PEAK*100:5.1f}% peak)", flush=True)
+    return sec
+
+
+def attn_fwd(H, D):
+    k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+    q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+    fl = 2 * 2 * B * H * T * T * D
+    timed_scan(lambda x: flash_attention(x, k, v, causal=True), q,
+               what=f"attn fwd H={H} D={D}", flops_per_iter=fl,
+               useful_frac=0.5)
+
+
+def attn_train(H, D):
+    k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+    q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+    fl = 6 * 2 * B * H * T * T * D
+
+    def loss(x):
+        return jnp.sum(flash_attention(x, k, v, causal=True
+                                       ).astype(jnp.float32))
+
+    def step(x):
+        return (x - 1e-3 * jax.grad(loss)(x)).astype(jnp.bfloat16)
+
+    timed_scan(step, q, n_hi=12, n_lo=4,
+               what=f"attn fwd+bwd H={H} D={D}", flops_per_iter=fl,
+               useful_frac=0.5)
+
+
+attn_fwd(16, 64)
+attn_fwd(8, 128)
+attn_train(16, 64)
+attn_train(8, 128)
+
+# dense parts
+xin = jax.random.normal(jax.random.key(3), (B, T, E), jnp.bfloat16)
+w_in = jax.random.normal(jax.random.key(5), (E, F), jnp.bfloat16)
+w_out = jax.random.normal(jax.random.key(6), (F, E), jnp.bfloat16)
+
+
+def ffn_loss(x):
+    u = jax.nn.gelu(jnp.einsum("bte,ef->btf", x, w_in))
+    y = x + jnp.einsum("btf,fe->bte", u, w_out)
+    return jnp.sum(y.astype(jnp.float32))
+
+
+def ffn_step(x):
+    return (x - 1e-3 * jax.grad(ffn_loss)(x)).astype(jnp.bfloat16)
+
+
+timed_scan(ffn_step, xin, n_hi=24, n_lo=8, what="ffn fwd+bwd",
+           flops_per_iter=6 * B * T * 2 * E * F)
+
+wq = jax.random.normal(jax.random.key(7), (E, E), jnp.bfloat16) * 0.01
+
+
+def proj_loss(x):
+    return jnp.sum((x + jnp.einsum("bte,ef->btf", x, wq)
+                    ).astype(jnp.float32))
+
+
+def proj_step(x):
+    return (x - 1e-3 * jax.grad(proj_loss)(x)).astype(jnp.bfloat16)
+
+
+timed_scan(proj_step, xin, n_hi=32, n_lo=8, what="proj fwd+bwd",
+           flops_per_iter=6 * B * T * E * E)
+
+unemb = jax.random.normal(jax.random.key(4), (E, V), jnp.bfloat16)
+tgt = jnp.asarray(np.random.default_rng(0).integers(0, V, (B, T)),
+                  jnp.int32)
+
+
+def head_loss(x, Tc=2048):
+    C = T // Tc
+    xs = jnp.moveaxis(x.reshape(B, C, Tc, E), 1, 0)
+    ts = jnp.moveaxis(tgt.reshape(B, C, Tc), 1, 0)
+
+    def chunk(_, xt):
+        x_c, t_c = xt
+        logits = jnp.einsum("bte,ev->btv", x_c, unemb,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return None, (lse - tl)
+
+    _, nll = jax.lax.scan(jax.checkpoint(chunk), None, (xs, ts))
+    return jnp.mean(nll)
+
+
+def head_step(x):
+    return (x - 1e-3 * jax.grad(head_loss)(x)).astype(jnp.bfloat16)
+
+
+timed_scan(head_step, xin, n_hi=12, n_lo=4, what="loss head (scan)",
+           flops_per_iter=6 * B * T * E * V)

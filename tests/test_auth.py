@@ -1,0 +1,196 @@
+"""Shared-secret auth for the two networked planes (docserver + blobserver).
+
+The reference honors Mongo auth on connect (cnn.lua:34-39 re-applies the
+auth table on every reconnect; make_sharded.lua:26-56 threads a password
+through the whole topology).  The rebuild's equivalent is a bearer token
+(utils/httpclient.py): a server constructed with one rejects tokenless
+clients; the token reaches clients explicitly, via the
+``TOKEN@HOST:PORT`` connstr form, or via $MAPREDUCE_TPU_AUTH.
+"""
+
+import pytest
+
+from mapreduce_tpu.coord.connection import Connection
+from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+from mapreduce_tpu.storage.httpstore import BlobServer, HttpStorage
+
+TOKEN = "sekrit-r5"
+
+
+@pytest.fixture(autouse=True)
+def _no_env_token(monkeypatch):
+    """Isolate from a machine-wide $MAPREDUCE_TPU_AUTH — the tokenless-
+    rejection tests must see genuinely tokenless clients."""
+    monkeypatch.delenv("MAPREDUCE_TPU_AUTH", raising=False)
+
+
+@pytest.fixture
+def doc_srv():
+    s = DocServer(auth_token=TOKEN).start_background()
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture
+def blob_srv(tmp_path):
+    s = BlobServer(str(tmp_path / "blobs"), port=0,
+                   auth_token=TOKEN).start_background()
+    yield s
+    s.shutdown()
+
+
+def test_docserver_rejects_tokenless_and_wrong_token(doc_srv):
+    addr = f"{doc_srv.host}:{doc_srv.port}"
+    with pytest.raises(PermissionError):
+        HttpDocStore(addr).ping()
+    with pytest.raises(PermissionError):
+        HttpDocStore(addr, auth_token="wrong").insert("c", {"x": 1})
+    assert doc_srv.store.count("c") == 0  # nothing slipped through
+
+
+def test_docserver_accepts_explicit_and_connstr_token(doc_srv):
+    addr = f"{doc_srv.host}:{doc_srv.port}"
+    st = HttpDocStore(addr, auth_token=TOKEN)
+    assert st.ping()
+    st.insert("c", {"_id": "a"})
+    assert st.count("c") == 1
+    st.close()
+    # token embedded in the address (the connstr form)
+    st2 = HttpDocStore(f"{TOKEN}@{addr}")
+    assert st2.find("c") == [{"_id": "a"}]
+    st2.close()
+
+
+def test_docserver_env_token(doc_srv, monkeypatch):
+    monkeypatch.setenv("MAPREDUCE_TPU_AUTH", TOKEN)
+    st = HttpDocStore(f"{doc_srv.host}:{doc_srv.port}")
+    assert st.ping()
+    st.close()
+
+
+def test_connection_auth_shapes(doc_srv):
+    """Connection honors auth as a plain token or a reference-shaped
+    {user, password} table (cnn.lua:106-113)."""
+    connstr = f"http://{doc_srv.host}:{doc_srv.port}"
+    for auth in (TOKEN, {"user": "u", "password": TOKEN},
+                 {"token": TOKEN}):
+        cnn = Connection(connstr, "db", auth)
+        assert cnn.connect().ping()
+        cnn.connect().close()
+        cnn._store = None
+    with pytest.raises(PermissionError):
+        Connection(connstr, "db").connect().ping()
+    # connstr-embedded token is visible to the STORAGE plane too (it is
+    # what Job/Server thread into router)
+    cnn = Connection(f"http://{TOKEN}@{doc_srv.host}:{doc_srv.port}", "db")
+    assert cnn.auth_token() == TOKEN
+    assert cnn.connect().ping()
+
+
+def test_blobserver_rejects_tokenless(blob_srv):
+    st = HttpStorage(blob_srv.address)
+    with pytest.raises(PermissionError):
+        st.write("k", "v")
+    with pytest.raises(PermissionError):
+        st.read("k")
+    with pytest.raises(PermissionError):
+        st.exists("k")
+    with pytest.raises(PermissionError):
+        st.list()
+    with pytest.raises(PermissionError):
+        st.remove("k")
+    with pytest.raises(PermissionError):
+        next(st.open_lines("k"))
+
+
+def test_blobserver_token_full_surface(blob_srv):
+    st = HttpStorage(blob_srv.address, auth_token=TOKEN)
+    st.write("k", "line1\nline2\n")
+    assert st.read("k") == "line1\nline2\n"
+    assert st.exists("k")
+    assert list(st.open_lines("k")) == ["line1", "line2"]  # Range path
+    assert st.list() == ["k"]
+    st.remove("k")
+    assert not st.exists("k")
+    # storage-DSL token form parses through the shared address parser
+    st2 = HttpStorage(f"{TOKEN}@{blob_srv.address}")
+    st2.write("k2", "v")
+    assert st2.read("k2") == "v"
+
+
+def test_user_module_storage_inherits_worker_auth(doc_srv, blob_srv):
+    """A mapfn that builds its OWN storage handle (router(DSL) in module
+    code — the netwc_mod / train_digits pattern) must inherit the
+    worker's auth token ambiently: no env var, no token in the DSL."""
+    from mapreduce_tpu.server import Server
+    from mapreduce_tpu.worker import spawn_worker_threads
+    import tests.netwc_mod as mod
+
+    dsl = f"http:{blob_srv.address}"
+    from mapreduce_tpu import storage as storage_mod
+
+    # seed the input blob with an authed handle
+    storage_mod.router(dsl, auth=TOKEN).write("in1", "p q p\n")
+
+    connstr = f"http://{doc_srv.host}:{doc_srv.port}"
+    threads = spawn_worker_threads(connstr, "ambwc", 2,
+                                   conf={"max_iter": 60}, auth=TOKEN)
+    m = "tests.netwc_mod"
+    server = Server(connstr, "ambwc", auth=TOKEN)
+    server.configure({
+        "taskfn": m, "mapfn": m, "partitionfn": m, "reducefn": m,
+        "finalfn": m, "storage": dsl,
+        "init_args": {"blobs": ["in1"], "num_reducers": 3,
+                      "storage": dsl},
+    })
+    server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    assert dict(mod.RESULT) == {"p": 2, "q": 1}
+
+
+def test_ambient_token_scoped_to_job_endpoints():
+    """The ambient job token must reach the job's own endpoints and NOT a
+    third-party host (leaking the cluster secret to a foreign blobserver
+    a user fn happens to dial)."""
+    from mapreduce_tpu.utils.httpclient import (
+        KeepAliveClient, push_ambient_auth, restore_ambient_auth)
+
+    prev = push_ambient_auth(TOKEN, {"10.0.0.1:8750"})
+    try:
+        own = KeepAliveClient("10.0.0.1", 8750)
+        foreign = KeepAliveClient("evil.example.com", 8750)
+        assert own.auth_token == TOKEN
+        assert foreign.auth_token is None
+    finally:
+        restore_ambient_auth(prev)
+    assert KeepAliveClient("10.0.0.1", 8750).auth_token is None  # restored
+
+
+def test_worker_server_auth_end_to_end(doc_srv, blob_srv, tmp_path):
+    """Workers and server holding the token complete a job whose board AND
+    blob storage both require auth — with a token-free storage DSL, so
+    the framework's auth threading (Connection -> router, not the connstr)
+    is what carries it."""
+    from mapreduce_tpu.server import Server
+    from mapreduce_tpu.worker import spawn_worker_threads
+
+    connstr = f"http://{doc_srv.host}:{doc_srv.port}"
+    import mapreduce_tpu.examples.wordcount as mod
+
+    f = tmp_path / "f1.txt"
+    f.write_text("a b a\n")
+    threads = spawn_worker_threads(connstr, "authwc", 2,
+                                   conf={"max_iter": 60}, auth=TOKEN)
+    m = "mapreduce_tpu.examples.wordcount"
+    server = Server(connstr, "authwc", auth=TOKEN)
+    server.configure({
+        "taskfn": m, "mapfn": m, "partitionfn": m, "reducefn": m,
+        "finalfn": m,
+        "storage": f"http:{blob_srv.address}",
+        "init_args": {"files": [str(f)], "num_reducers": 3},
+    })
+    server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    assert dict(mod.RESULT) == {"a": 2, "b": 1}
