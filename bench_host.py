@@ -1,0 +1,185 @@
+"""Host-plane benchmark: Europarl-scale word count through the GENERAL
+path — N OS-process workers over a docserver job board and http blob
+storage, the topology of the reference's published numbers.
+
+The reference's entire perf story is this path: 146.53s with 1 CPU
+worker, 47.372s with 4, 32s with 30 (reference README.md:70,77-79), over
+N Lua worker processes + one mongod.  This bench runs the same-scale
+corpus (bench.py's generator: 49,158,635 words / 1,965,734 lines,
+Zipf-ranked vocabulary) through OUR equivalent: worker OS processes that
+claim jobs from a DocServer over TCP and move bytes through a BlobServer
+over TCP — zero shared filesystem, no accelerator involved.  The map
+body runs the in-tree C++ tokenizer/pre-aggregator (native/mr_native.cpp)
+the way the reference's workers lean on Lua C extensions.
+
+Clock semantics match the reference: wall time of the map+reduce task
+with the corpus ALREADY split and resident in cluster storage (its
+Europarl splits pre-exist in GridFS; split upload is reported separately
+as ``setup_s``) and workers already up (it starts screen sessions first,
+test.sh:10).
+
+Prints ONE JSON line:
+    {"metric": "europarl_wordcount_host_wall_s", "value": <s>,
+     "unit": "s", "vs_baseline": <47.372 / s>, "workers": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+BASELINE_4W_S = 47.372       # reference README.md:70 (4 workers)
+BASELINE_1W_S = 146.53       # reference README.md:77
+BASELINE_30W_S = 32.0        # reference README.md:79
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def split_corpus(corpus: bytes, n_splits: int):
+    """Split on line boundaries into ~equal byte chunks."""
+    out = []
+    target = len(corpus) // n_splits
+    lo = 0
+    for _ in range(n_splits - 1):
+        hi = corpus.find(b"\n", lo + target)
+        if hi < 0:
+            break
+        out.append(corpus[lo:hi + 1])
+        lo = hi + 1
+    out.append(corpus[lo:])
+    return [c for c in out if c]
+
+
+def main() -> None:
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        scale = 0.002
+    workers = int(os.environ.get("BENCH_WORKERS", "4"))
+    for i, a in enumerate(sys.argv):
+        if a == "--workers":
+            workers = int(sys.argv[i + 1])
+    n_splits = max(4 * workers, 16)
+    n_reducers = 15  # the reference example's partition count
+
+    from bench import N_LINES, N_WORDS, make_corpus
+    from mapreduce_tpu import native
+    from mapreduce_tpu.coord.docserver import DocServer
+    from mapreduce_tpu.storage import BlobServer
+    from mapreduce_tpu.storage.httpstore import HttpStorage
+
+    t0 = time.time()
+    corpus = make_corpus(int(N_WORDS * scale), max(int(N_LINES * scale), 1))
+    gen_s = time.time() - t0
+    print(f"# corpus {len(corpus)/1e6:.0f} MB in {gen_s:.1f}s; "
+          f"starting services ...", file=sys.stderr, flush=True)
+
+    doc = DocServer(host="127.0.0.1", port=0).start_background()
+    blob_root = tempfile.mkdtemp(prefix="bench_host_blobs_")
+    blob = BlobServer(blob_root, host="127.0.0.1", port=0).start_background()
+    connstr = f"http://127.0.0.1:{doc.port}"
+    storage_dsl = f"http:127.0.0.1:{blob.port}"
+
+    # worker OS processes dialing the board over TCP (reference: N Lua
+    # processes under screen, test.sh:10); spawned first so interpreter
+    # startup overlaps the split upload, like screen sessions preceding
+    # the server in test.sh
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "mapreduce_tpu.cli", "worker",
+             connstr, "bhost", "--max-tasks", "1", "--max-iter", "240"],
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for _ in range(workers)
+    ]
+
+    # stage the splits into cluster storage (reference: pre-loaded GridFS)
+    t1 = time.time()
+    splits = split_corpus(corpus, n_splits)
+    st = HttpStorage(f"127.0.0.1:{blob.port}")
+    names = []
+    for i, chunk in enumerate(splits):
+        name = f"europarl.{i:05d}"
+        st.write(name, chunk.decode("utf-8"))
+        names.append(name)
+    setup_s = time.time() - t1
+    print(f"# {len(names)} splits staged over http in {setup_s:.1f}s",
+          file=sys.stderr, flush=True)
+
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(name)s: %(message)s")
+    logging.getLogger("mapreduce_tpu.coord").setLevel(logging.WARNING)
+
+    try:
+        from mapreduce_tpu.server import Server
+
+        m = "mapreduce_tpu.examples.wordcount_native"
+        server = Server(connstr, "bhost")
+        server.configure({
+            "taskfn": m, "mapfn": m, "partitionfn": m, "reducefn": m,
+            "finalfn": m, "combinerfn": m,
+            "storage": storage_dsl,
+            "init_args": {"blobs": names, "num_reducers": n_reducers,
+                          "storage": storage_dsl},
+        })
+        t2 = time.time()
+        stats = server.loop()
+        wall = time.time() - t2
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # independent full-corpus oracle through the native core
+    from mapreduce_tpu.examples.wordcount_native import RESULT
+
+    total = sum(RESULT.values())
+    assert total == int(N_WORDS * scale), (total, int(N_WORDS * scale))
+    if native.native_available():
+        oracle = {w.decode("utf-8", "replace"): c
+                  for w, c in native.wordcount_bytes(corpus).items()}
+        if RESULT != oracle:
+            print(f"ORACLE MISMATCH: {len(set(RESULT) ^ set(oracle))} "
+                  "key diffs", file=sys.stderr)
+            sys.exit(1)
+        print(f"# oracle agrees: {len(oracle)} uniques",
+              file=sys.stderr, flush=True)
+
+    doc.shutdown()
+    blob.shutdown()
+
+    result = {
+        "metric": "europarl_wordcount_host_wall_s",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_4W_S / wall, 2),
+        "workers": workers,
+        "splits": len(names),
+        "reducers": n_reducers,
+        "setup_s": round(setup_s, 1),
+        "baselines": {"ref_1w_s": BASELINE_1W_S, "ref_4w_s": BASELINE_4W_S,
+                      "ref_30w_s": BASELINE_30W_S},
+        "topology": "N worker OS processes over http docserver + http "
+                    "blobserver, zero shared filesystem; C++ tokenizer "
+                    "map body",
+        "phase_stats": {
+            "map_cluster_s": round((stats or {}).get(
+                "map", {}).get("cluster_time", 0.0), 2),
+            "reduce_cluster_s": round((stats or {}).get(
+                "reduce", {}).get("cluster_time", 0.0), 2),
+        },
+    }
+    print(json.dumps(result, default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -177,7 +177,7 @@ class DocServer:
             "done": collections.OrderedDict(),
             "inflight": {},
             "dedupe_lock": threading.Lock(),
-            "auth_token": default_auth_token(auth_token, ambient=False),
+            "auth_token": default_auth_token(auth_token),
         })
         self.store = handler.store
         self.httpd = http.server.ThreadingHTTPServer((host, port), handler)

@@ -133,8 +133,7 @@ class BlobServer:
                  port: int = 0, auth_token: Optional[str] = None) -> None:
         handler = type("BoundHandler", (_Handler,),
                        {"store": LocalDirStorage(root),
-                        "auth_token": default_auth_token(auth_token,
-                                                         ambient=False)})
+                        "auth_token": default_auth_token(auth_token)})
         self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None

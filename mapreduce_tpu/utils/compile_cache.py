@@ -25,13 +25,42 @@ DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), ".jax_cache")
 
+#: fallback for read-only installs (site-packages): a user cache dir —
+#: warmup must not silently fail to persist the ~100s compile it exists
+#: to avoid
+USER_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME",
+                   os.path.join(os.path.expanduser("~"), ".cache")),
+    "mapreduce_tpu", "jax_cache")
+
+
+def _writable_dir(path: str) -> bool:
+    try:
+        os.makedirs(path, exist_ok=True)
+        # pid-suffixed: concurrent probers (bench_host's worker fleet)
+        # must not race on one name and wrongly divert to USER_DIR
+        probe = os.path.join(path, f".write_probe.{os.getpid()}")
+        with open(probe, "w"):
+            pass
+        try:
+            os.remove(probe)
+        except FileNotFoundError:
+            pass
+        return True
+    except OSError:
+        return False
+
 
 def enable_persistent_cache(path: Optional[str] = None) -> str:
-    """Point XLA's persistent compilation cache at *path* (default: the
-    package-adjacent ``.jax_cache``).  Idempotent; returns the path."""
+    """Point XLA's persistent compilation cache at *path* (default:
+    $MAPREDUCE_TPU_CACHE, else the package-adjacent ``.jax_cache``,
+    else — when the install location isn't writable — the user cache
+    dir).  Idempotent; returns the path."""
     import jax
 
-    path = path or os.environ.get("MAPREDUCE_TPU_CACHE", DEFAULT_DIR)
+    path = path or os.environ.get("MAPREDUCE_TPU_CACHE")
+    if not path:
+        path = DEFAULT_DIR if _writable_dir(DEFAULT_DIR) else USER_DIR
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return path

@@ -83,13 +83,12 @@ def ambient_token_for(host: str, port: int) -> Optional[str]:
     return token
 
 
-def default_auth_token(explicit: Optional[str] = None,
-                       ambient: bool = True) -> Optional[str]:
+def default_auth_token(explicit: Optional[str] = None) -> Optional[str]:
     """Resolve a token: explicit argument beats the environment.  The
-    ambient job token is a CLIENT channel resolved per-endpoint in
-    KeepAliveClient (it needs the address for scoping); servers resolve
-    here with ``ambient=False`` semantics either way — a scratch server
-    built inside a job window must not silently become auth-required."""
+    ambient job token is deliberately NOT consulted here — it is a
+    CLIENT channel resolved per-endpoint in KeepAliveClient.__init__
+    (scoping needs the address), and the servers that call this must
+    not silently become auth-required inside a job window."""
     if explicit is not None:
         return explicit or None  # "" means "explicitly open"
     return os.environ.get(AUTH_ENV) or None

@@ -16,6 +16,7 @@ are arbitrary Python objects (SURVEY.md §7 hard-part (c)).
 from __future__ import annotations
 
 import ast
+import json
 from typing import Any, Iterable, Iterator, Tuple
 
 # types a key/value may contain, transitively (reference restricts to what
@@ -77,14 +78,33 @@ def normalize(obj: Any) -> Any:
     raise TypeError(f"cannot normalize {type(obj).__name__!r}")
 
 
+#: scalar types that round-trip through JSON unchanged (json.dumps emits
+#: Infinity/NaN tokens and json.loads reads them back, so floats qualify)
+_JSON_SCALARS = (str, int, float, bool, type(None))
+
+
 def serialize_record(key: Any, values: Any) -> str:
     """One ``(key, value_list)`` record -> one text line.
 
     Mirrors the reference's ``"return <escaped_k>,{v,...}\\n"`` writer
-    (job.lua:209-215).  ``repr`` escapes newlines inside strings, so the
-    line framing is safe.
+    (job.lua:209-215).  The common shape — scalar key, list of scalars —
+    is written as a JSON array (``json.loads`` parses ~10x faster than
+    the ast path, and the reduce merge parses EVERY map record); richer
+    records (bytes, tuples, dicts) fall back to ``repr``.  The two are
+    unambiguous at parse time: JSON lines start with ``[``, repr tuples
+    with ``(``.  Both escape newlines, so line framing is safe either
+    way.
     """
-    return repr((normalize(key), normalize(values)))
+    key = normalize(key)
+    values = normalize(values)
+    if type(key) in _JSON_SCALARS or key is None:
+        if isinstance(values, list) and all(
+                type(v) in _JSON_SCALARS or v is None for v in values):
+            # ensure_ascii: lone surrogates (surrogateescape'd input,
+            # os.fsdecode'd names) must reach storage as ASCII escapes —
+            # a raw '\ud800' kills the backend's utf-8 file write
+            return json.dumps([key, values], check_circular=False)
+    return repr((key, values))
 
 
 def _eval_literal(node: ast.AST) -> Any:
@@ -118,8 +138,14 @@ def _eval_literal(node: ast.AST) -> Any:
 
 def parse_record(line: str) -> Tuple[Any, Any]:
     """Inverse of :func:`serialize_record` (reference: ``load(line)()``,
-    utils.lua:233-236 -- but safe: no code execution is possible)."""
-    tree = ast.parse(line.strip(), mode="eval")
+    utils.lua:233-236 -- but safe: no code execution is possible on
+    either path — json.loads is data-only and the ast path evaluates
+    literals)."""
+    line = line.strip()
+    if line.startswith("["):  # the JSON fast path's unambiguous marker
+        key, values = json.loads(line)
+        return key, values
+    tree = ast.parse(line, mode="eval")
     key, values = _eval_literal(tree.body)
     return key, values
 
