@@ -48,9 +48,13 @@ class TransformerConfig:
     #: ~4x longer context per device for ~30% recompute — the standard
     #: long-context trade (HBM is the bottleneck, not FLOPs)
     remat: bool = False
-    #: flash-style chunking of each ring step's local attention: scores
-    #: materialise [T_local, attn_block] instead of [T_local, T_local]
-    #: (parallel/ring.py block_size); None = unchunked
+    #: tile request for the attention. Single-device flash path: the
+    #: kernel's block_q/block_kv (None = the kernel default, 1024-row
+    #: tiles — the measured v5e sweet spot). Multi-device ring on the
+    #: jnp fallback (flash=False off-TPU): the online-softmax chunk
+    #: (parallel/ring.py block_size; None = unchunked). The TPU ring
+    #: dispatches to the Pallas kernel, which tiles itself and IGNORES
+    #: this knob.
     attn_block: Any = None
     #: sequence-chunked cross-entropy: logits materialise
     #: [B, loss_block, V/n_model] instead of [B, T_local, V/n_model] —
@@ -74,12 +78,12 @@ class TransformerConfig:
     #: grows) and routing collapses onto one expert
     moe_aux_weight: float = 0.01
     #: use the in-tree Pallas flash-attention kernel
-    #: (ops/flash_attention.py) for the local attention instead of the
-    #: jnp ring path.  None = auto: on when the sequence is NOT sharded
-    #: (data axis 1 — the kernel computes exact local attention; the
-    #: multi-device ring keeps the jnp online-softmax path) and the
-    #: backend is TPU.  True forces it (tests run the interpreter on
-    #: CPU); False forces the jnp path.
+    #: (ops/flash_attention.py).  None = auto: the unsharded case
+    #: (data axis 1) calls the kernel directly on TPU; the multi-device
+    #: ring ALSO dispatches each ring step's local attention to the
+    #: kernel on TPU (parallel/ring.py use_flash auto), falling back to
+    #: the jnp online-softmax path off-TPU.  True forces the kernel
+    #: (tests run the interpreter on CPU); False forces jnp everywhere.
     flash: Any = None
 
     def validate(self, n_model: int) -> None:
@@ -155,7 +159,8 @@ def _layer_local(x: jax.Array, lp: Params, cfg: TransformerConfig,
         w = lp["wqkv"].astype(cfg.dtype).reshape(E, 3, H_loc, D)
         qkv = jnp.einsum("bte,echd->bchtd", h, w)
         # attn_block doubles as the kernel tile request (auto-shrunk to
-        # divide T); default 512 is the measured sweet spot on v5e
+        # divide T); the kernel default, 1024, is the measured v5e
+        # sweet spot
         bk = dict(block_q=cfg.attn_block, block_kv=cfg.attn_block) \
             if cfg.attn_block else {}
         attn = flash_attention(qkv[:, 0], qkv[:, 1], qkv[:, 2],

@@ -86,9 +86,39 @@ def _sds(shape, dtype, like):
 # -- forward -----------------------------------------------------------------
 
 
+def _crosses_diag(iq, j, block_q, block_kv):
+    """Does KV tile j contain any masked (above-diagonal) element for Q
+    tile iq?  False for tiles strictly below the diagonal — those run
+    the unmasked body, skipping the iota/compare/select VPU passes that
+    dominate a VPU-bound kernel (the MXU work per tile is ~4us; 31/32 of
+    a 32K causal grid's needed tiles never cross the diagonal)."""
+    return j * block_kv + block_kv - 1 > iq * block_q
+
+
+def _dispatch_tile(accum, needed, causal, iq, j, block_q, block_kv):
+    """Run *accum(mask)* under the masked/full split all three kernels
+    share: diagonal-crossing tiles take the masked body, strictly-below
+    tiles the unmasked one, non-causal always unmasked."""
+    if not causal:
+        accum(False)
+        return
+    diag = _crosses_diag(iq, j, block_q, block_kv)
+
+    @pl.when(needed & diag)
+    def _tile_masked():
+        accum(True)
+
+    @pl.when(needed & jnp.logical_not(diag))
+    def _tile_full():
+        accum(False)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, den_scr, acc_scr,
-                *, scale, causal, block_q, block_kv):
+                *, causal, block_q, block_kv, n_kv):
+    # q arrives PRE-SCALED by 1/sqrt(D) (see _fwd_call): one elementwise
+    # pass over [B,H,T,D] outside replaces a [block_q,block_kv] scale
+    # pass in every tile
     iq = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -102,15 +132,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     k_start = j * block_kv
     needed = _on_diag(iq, j, block_q, block_kv) if causal else True
 
-    @pl.when(needed)
-    def _tile():
+    def _accum(mask):
         q = q_ref[0, 0]  # [block_q, D]
         k = k_ref[0, 0]  # [block_kv, D]
         v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
+            preferred_element_type=jnp.float32)
+        if mask:
             qp = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             kp = k_start + jax.lax.broadcasted_iota(
@@ -128,18 +157,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[:, 0:1] = m_new
         den_scr[:, 0:1] = den
 
-    # emit every step (VMEM-resident until the Q-block index changes;
-    # only the final KV step's value reaches HBM)
-    den = jnp.maximum(den_scr[:, 0:1], 1e-30)
-    o_ref[0, 0] = (acc_scr[...] / den).astype(o_ref.dtype)
-    lse_ref[0, 0] = m_scr[:, 0:1] + jnp.log(den)
+    _dispatch_tile(_accum, needed, causal, iq, j, block_q, block_kv)
+
+    # emit once, on the final KV step (the j-loop keeps (m, den, acc) in
+    # VMEM scratch; dividing every step cost a [block_q, D] divide + log
+    # per tile for values that never left VMEM)
+    @pl.when(j == n_kv - 1)
+    def _emit():
+        den = jnp.maximum(den_scr[:, 0:1], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / den).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, 0:1] + jnp.log(den)
 
 
 # -- backward: dQ pass -------------------------------------------------------
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_kv):
+               dq_scr, *, scale, causal, block_q, block_kv, n_kv):
+    # q is pre-scaled (q^ = q/sqrt(D)); the kernel accumulates dq^ = ds.k
+    # and the one final emission multiplies by scale (chain rule through
+    # q^ = scale*q), replacing a per-tile [block_q, D] scale pass
     iq = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -151,8 +188,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     k_start = j * block_kv
     needed = _on_diag(iq, j, block_q, block_kv) if causal else True
 
-    @pl.when(needed)
-    def _tile():
+    def _accum(mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -161,8 +197,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
+            preferred_element_type=jnp.float32)
+        if mask:
             qp = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             kp = k_start + jax.lax.broadcasted_iota(
@@ -175,9 +211,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         ds = p * (dp - delta)           # [block_q, block_kv] f32
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
 
-    dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+    _dispatch_tile(_accum, needed, causal, iq, j, block_q, block_kv)
+
+    @pl.when(j == n_kv - 1)
+    def _emit():
+        dq_ref[0, 0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 # -- backward: dK/dV pass ----------------------------------------------------
@@ -185,7 +225,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, block_q, block_kv):
+                *, causal, block_q, block_kv, n_q):
+    # q is pre-scaled, so dK = dS^T . q^ needs NO scale factor at all
+    # (dk = dS^T . scale*q exactly)
     jk = pl.program_id(2)
     i = pl.program_id(3)
 
@@ -198,8 +240,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = jk * block_kv
     needed = (q_start + block_q - 1 >= k_start) if causal else True
 
-    @pl.when(needed)
-    def _tile():
+    def _accum(mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -208,8 +249,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
+            preferred_element_type=jnp.float32)
+        if mask:
             qp = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             kp = k_start + jax.lax.broadcasted_iota(
@@ -224,13 +265,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        # dK += dS^T . Q
+        # dK += dS^T . Q^
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
 
-    dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+    _dispatch_tile(_accum, needed, causal, i, jk, block_q, block_kv)
+
+    @pl.when(i == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # -- pallas_call wrappers ----------------------------------------------------
@@ -257,13 +302,14 @@ def _fwd_call(q, k, v, cfgt):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     n_q, n_kv = Tq // block_q, Tk // block_kv
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)  # q^ = q/sqrt(D)
     kv_index = _make_kv_index(causal, block_q, block_kv, n_kv)
     q_spec = pl.BlockSpec((1, 1, block_q, D), _q_index)
     kv_spec = pl.BlockSpec((1, 1, block_kv, D), kv_index)
     row_spec = pl.BlockSpec((1, 1, block_q, 1), _q_index)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv)
+        _fwd_kernel, causal=causal,
+        block_q=block_q, block_kv=block_kv, n_kv=n_kv)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_kv),
@@ -284,6 +330,9 @@ def _bwd_call(q, k, v, out, lse, do, cfgt, dlse=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     n_q, n_kv = Tq // block_q, Tk // block_kv
+    # the kernels recompute s from the PRE-SCALED q^ (matching _fwd_call's
+    # lse); dq picks scale back up at emission, dk needs none (dk=dS^T.q^)
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     # delta[b,h,t] = sum_d dO * O — a tiny elementwise pass, jnp is fine
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B, H, Tq, 1]
@@ -299,7 +348,7 @@ def _bwd_call(q, k, v, out, lse, do, cfgt, dlse=None):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_kv=block_kv),
+                          block_q=block_q, block_kv=block_kv, n_kv=n_kv),
         grid=(B, H, n_q, n_kv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -324,8 +373,8 @@ def _bwd_call(q, k, v, out, lse, do, cfgt, dlse=None):
     kv_spec2 = pl.BlockSpec((1, 1, block_kv, D), kv_index2)
     row_spec2 = pl.BlockSpec((1, 1, block_q, 1), q_index2)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_kv=block_kv),
+        functools.partial(_dkv_kernel, causal=causal,
+                          block_q=block_q, block_kv=block_kv, n_q=n_q),
         grid=(B, H, n_kv, n_q),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
                   row_spec2],

@@ -60,7 +60,19 @@ def enable_persistent_cache(path: Optional[str] = None) -> str:
 
     path = path or os.environ.get("MAPREDUCE_TPU_CACHE")
     if not path:
-        path = DEFAULT_DIR if _writable_dir(DEFAULT_DIR) else USER_DIR
+        for cand in (DEFAULT_DIR, USER_DIR):
+            if _writable_dir(cand):
+                path = cand
+                break
+        else:  # nothing writable: persist nowhere, but SAY so
+            path = USER_DIR
+            import logging
+
+            logging.getLogger("mapreduce_tpu.compile_cache").warning(
+                "no writable compile-cache dir (tried %s, %s): every "
+                "process will re-pay the ~100s cold compile; set "
+                "$MAPREDUCE_TPU_CACHE to a writable path",
+                DEFAULT_DIR, USER_DIR)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return path

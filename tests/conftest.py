@@ -11,7 +11,13 @@ import sys
 
 # force, not setdefault: the machine env pins JAX_PLATFORMS=axon (the real
 # TPU tunnel); correctness tests must run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# MAPREDUCE_TPU_TESTS=1 opts OUT of the pin for the hardware-gated tests
+# (test_flash_attention.py's compiled-Mosaic cases): run
+#   MAPREDUCE_TPU_TESTS=1 pytest tests/test_flash_attention.py -k tpu
+# on a machine with a real chip.
+_USE_TPU = os.environ.get("MAPREDUCE_TPU_TESTS") == "1"
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -26,4 +32,5 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # authoritative.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,88 @@
+"""Subprocess body for the COMBINED cross-host topology test: the two
+planes that were only ever proven separately, in one deployment —
+
+* data plane: the SPMD device engine over a ``jax.distributed`` mesh
+  SPANNING 2 OS processes (multi-controller collectives), and
+* control/storage plane: job coordination over an http DocServer and
+  all bytes over an http BlobServer — zero shared filesystem.
+
+Process 0 plays the server role: claims the job doc over http
+(find_and_modify, the atomic mongod-style claim), runs the engine,
+publishes the result to the blobserver, marks the job WRITTEN.  Process
+1 is a second controller: it executes the SAME engine program (SPMD
+contract), then waits on the BOARD (not the filesystem) for WRITTEN and
+verifies the published result matches its own engine output — the
+cross-process agreement travels through the networked planes the way a
+real deployment's would.
+
+Usage: multiproc_runner2.py <pid> <nprocs> <port> <doc_connstr> <blob>
+"""
+
+import sys
+import time
+
+
+def main() -> int:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    doc_connstr, blob_addr = sys.argv[4], sys.argv[5]
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs, process_id=pid)
+    print(f"MARKER devices global={len(jax.devices())} "
+          f"local={len(jax.local_devices())}", flush=True)
+
+    from mapreduce_tpu.coord import docstore
+    from mapreduce_tpu.engine import DeviceWordCount
+    from mapreduce_tpu.parallel import make_mesh
+    from mapreduce_tpu.storage.httpstore import HttpStorage
+    from mapreduce_tpu.utils.serialization import parse_record, \
+        serialize_record
+
+    board = docstore.connect(doc_connstr)
+    blobs = HttpStorage(blob_addr)
+
+    # input comes from the blob plane on BOTH controllers (identical
+    # bytes is the SPMD requirement a shared corpus blob satisfies)
+    corpus = blobs.read("corpus").encode("utf-8")
+    mesh = make_mesh()
+    wc = DeviceWordCount(mesh, chunk_len=512)
+    counts = wc.count_bytes(corpus)
+    print(f"MARKER engine ok uniques={len(counts)}", flush=True)
+
+    if pid == 0:
+        # the server role: atomic claim -> publish result -> WRITTEN
+        claimed = board.find_and_modify(
+            "xhost.jobs", {"_id": "wc", "status": "ENQUEUED"},
+            {"$set": {"status": "RUNNING", "worker": "p0"}})
+        assert claimed is not None, "claim failed"
+        lines = [serialize_record(k.decode("utf-8"), [v])
+                 for k, v in sorted(counts.items())]
+        blobs.write("result", "\n".join(lines) + "\n")
+        n = board.update("xhost.jobs", {"_id": "wc"},
+                         {"$set": {"status": "WRITTEN"}})
+        assert n == 1
+        print("MARKER served ok", flush=True)
+    else:
+        # second controller: wait on the BOARD, then verify the
+        # published result against this process's own engine output
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            docs = board.find("xhost.jobs", {"_id": "wc"})
+            if docs and docs[0]["status"] == "WRITTEN":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("job never reached WRITTEN")
+        got = dict(parse_record(ln) for ln in
+                   blobs.read("result").splitlines() if ln)
+        mine = {k.decode("utf-8"): [v] for k, v in counts.items()}
+        assert got == mine, (len(got), len(mine))
+        print("MARKER verified ok", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
