@@ -120,6 +120,16 @@ class _WaveFeeder:
         self._futs: dict = {}
         self._ready: dict = {}
         self._submitted = 0
+        # first-party HBM-bound accounting: bytes of input waves held
+        # (submitted and not yet released).  The axon fixture exposes no
+        # memory_stats(), so the bound is asserted on this ledger plus a
+        # jax.live_arrays() cross-check (tests/test_device_engine.py).
+        self._wave_nbytes = int(
+            self.rpw * int(np.prod(chunks.shape[1:], dtype=np.int64))
+            * chunks.dtype.itemsize + self.rpw * 4)  # + i32 indices
+        self._accounted: set = set()
+        self.held_bytes = 0
+        self.peak_held_bytes = 0
 
     @property
     def n_real(self):
@@ -157,6 +167,11 @@ class _WaveFeeder:
                 max_workers=min(self.waves, 8))
         for w in range(self._submitted, upto + 1):
             self._futs[w] = self._pool.submit(self._put_wave, w)
+            if w not in self._accounted:
+                self._accounted.add(w)
+                self.held_bytes += self._wave_nbytes
+                self.peak_held_bytes = max(self.peak_held_bytes,
+                                           self.held_bytes)
         self._submitted = upto + 1
 
     def get(self, w: int):
@@ -168,6 +183,9 @@ class _WaveFeeder:
 
     def release(self, w: int) -> None:
         self._ready.pop(w, None)
+        if w in self._accounted:
+            self._accounted.discard(w)
+            self.held_bytes -= self._wave_nbytes
 
     def reset(self) -> None:
         self.close()
@@ -183,6 +201,8 @@ class _WaveFeeder:
             self._pool = None
         self._futs.clear()
         self._ready.clear()
+        self._accounted.clear()
+        self.held_bytes = 0
 
 
 class DeviceEngine:
@@ -705,6 +725,12 @@ class DeviceEngine:
         if timings is not None:
             timings["waves"] = W
             timings["retries"] = retries
+            if feeder is not None:
+                # the HBM-bound witness: peak bytes of input waves ever
+                # held at once (~STREAM_PREFETCH waves), vs the corpus
+                timings["peak_input_wave_bytes"] = feeder.peak_held_bytes
+                if chunks is not None:
+                    timings["input_bytes"] = int(chunks.nbytes)
             if staged is None:  # staged callers timed the upload already
                 timings["upload_s"] = round(t_upload, 3)
             elif t_upload > 0.01:  # resolved-handle waits are ~0

@@ -1,0 +1,45 @@
+"""Attribute the first-run warmup (VERDICT r4 weak 2: BENCH_r04 showed
+compile_s 43.2 = AOT 12.0 + ~31s first end-to-end run, cache present).
+
+Where does the first count_bytes go that the second doesn't?  Stage-level
+diff of run1 vs run2 timings on a mid-size corpus, plus a separate
+second-process rerun to see what a WARM machine (cache + server process
+restart) pays.
+"""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np
+
+from mapreduce_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+import jax
+
+from bench import make_corpus, N_WORDS, N_LINES
+from mapreduce_tpu.engine import DeviceWordCount
+from mapreduce_tpu.engine.wordcount import bench_engine_config
+from mapreduce_tpu.parallel import make_mesh
+
+SCALE = float(os.environ.get("SCALE", "0.1"))
+
+t0 = time.time()
+corpus = make_corpus(int(N_WORDS * SCALE), int(N_LINES * SCALE))
+print(f"corpus {len(corpus)/1e6:.0f}MB in {time.time()-t0:.1f}s",
+      flush=True)
+
+wc = DeviceWordCount(make_mesh(), chunk_len=1 << 22,
+                     config=bench_engine_config())
+
+t0 = time.time()
+aot = wc.warm()
+print(f"warm() AOT: {aot:.1f}s (wall {time.time()-t0:.1f}s)", flush=True)
+
+for r in range(3):
+    tm = {}
+    t0 = time.time()
+    counts = wc.count_bytes(corpus, timings=tm)
+    wall = time.time() - t0
+    print(f"run{r}: wall {wall:6.2f}s  stages: "
+          + " ".join(f"{k}={v}" for k, v in sorted(tm.items())
+                     if isinstance(v, (int, float))), flush=True)
+print(f"uniques={len(counts)}", flush=True)

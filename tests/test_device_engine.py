@@ -335,3 +335,42 @@ def test_device_wordcount_mixed_mesh():
     wc = DeviceWordCount(mesh, chunk_len=2048)
     got = wc.count_bytes(data, waves=2)  # wave merge on the mixed mesh too
     assert got == _oracle(data)
+
+
+def test_streaming_hbm_byte_bound(wc_mesh, monkeypatch):
+    """VERDICT r4 item 4: the HBM bound asserted in BYTES, two ways.
+    (a) the feeder's first-party ledger (peak bytes of input waves held
+    at once) lands in timings and stays ~STREAM_PREFETCH waves, a small
+    fraction of the corpus; (b) a jax.live_arrays() cross-check counts
+    the ACTUAL live uint8 device buffers at every wave release — real
+    allocator state, needed because the axon fixture's memory_stats()
+    returns no byte fields."""
+    import mapreduce_tpu.engine.device_engine as de
+
+    live_u8_peak = [0]
+    orig_release = de._WaveFeeder.release
+
+    def sampling_release(self, w):
+        n = sum(int(a.nbytes) for a in jax.live_arrays()
+                if a.dtype == jnp.uint8)
+        live_u8_peak[0] = max(live_u8_peak[0], n)
+        orig_release(self, w)
+
+    monkeypatch.setattr(de._WaveFeeder, "release", sampling_release)
+    data = _random_text(n_words=60000, seed=9)
+    wc = DeviceWordCount(wc_mesh, chunk_len=512)
+    tm = {}
+    got = wc.count_bytes(data, timings=tm, waves=8)
+    assert got == _oracle(data)
+    assert tm["waves"] == 8
+
+    corpus = tm["input_bytes"]
+    peak = tm["peak_input_wave_bytes"]
+    # ledger: at most prefetch+1 waves ever held; far below the corpus
+    assert peak <= (de.DeviceEngine.STREAM_PREFETCH + 1) * (
+        -(-corpus // tm["waves"]) + 8192), (peak, corpus)
+    assert peak <= corpus // 2, (peak, corpus)
+    # allocator truth: live uint8 bytes (inputs + bounded outputs) never
+    # approached corpus size while waves streamed
+    assert 0 < live_u8_peak[0] < corpus, (live_u8_peak, corpus)
+    assert live_u8_peak[0] <= corpus * 3 // 4, (live_u8_peak, corpus)
