@@ -403,3 +403,63 @@ class TransformerTrainer:
     def step(self, params: Params, tokens: np.ndarray):
         x, y = self.place_batch(tokens)
         return self._train_step(params, x, y)
+
+    # -- checkpointing (the reference's GridFS-serialized trainer role,
+    # common.lua:24-39; shares the MLP trainer's atomic npz format) -----
+
+    def _arch_tag(self) -> str:
+        """Canonical architecture string — catches same-shape scrambles
+        (n_heads=4/head_dim=8 vs 8/4 give IDENTICAL wqkv shapes) that no
+        shape check can."""
+        c = self.cfg
+        return (f"v{c.vocab}.e{c.embed}.l{c.n_layers}.h{c.n_heads}."
+                f"d{c.head_dim}.f{c.ffn}.moe{c.moe_experts}")
+
+    def save(self, path: str, params: Params, step: int = 0) -> None:
+        """Write an atomic npz (save_checkpoint gathers to host).
+        Single-controller: under multi-process ``jax.distributed`` the
+        shards on other hosts aren't addressable here — gather with
+        multihost utils before calling, or save per-process shards."""
+        from .trainer import save_checkpoint
+
+        host = dict(params)
+        host["__arch__"] = np.frombuffer(
+            self._arch_tag().encode(), dtype=np.uint8)
+        save_checkpoint(path, host, step)
+
+    def load(self, path: str) -> Tuple[Params, int]:
+        """Load an npz checkpoint and re-place every tensor with its
+        tp-sharding on this trainer's mesh (a checkpoint saved on one
+        mesh layout restores onto another — resharding is just
+        device_put with the new NamedSharding).  Rejects checkpoints
+        whose architecture, param names, shapes, or dtypes don't match
+        this trainer's config — a same-key different-width load must
+        fail HERE, not as a cryptic trace error inside the jitted step."""
+        from .trainer import load_checkpoint
+
+        host, step = load_checkpoint(path)
+        arch = host.pop("__arch__", None)
+        if arch is not None:
+            got = bytes(bytearray(arch)).decode()
+            if got != self._arch_tag():
+                raise ValueError(
+                    f"checkpoint params do not match this config: "
+                    f"checkpoint arch {got}, trainer {self._arch_tag()}")
+        missing = set(self._pspecs) ^ set(host)
+        if missing:
+            raise ValueError(
+                f"checkpoint params do not match this config: {missing}")
+        ref = jax.eval_shape(
+            lambda: init_transformer(jax.random.key(0), self.cfg))
+        bad = [n for n in self._pspecs
+               if host[n].shape != ref[n].shape
+               or host[n].dtype != ref[n].dtype]
+        if bad:
+            raise ValueError(
+                "checkpoint params do not match this config (shape/dtype): "
+                + ", ".join(f"{n} {host[n].shape}/{host[n].dtype} vs "
+                            f"{ref[n].shape}/{ref[n].dtype}" for n in bad))
+        params = {n: jax.device_put(
+                      host[n], NamedSharding(self.mesh, self._pspecs[n]))
+                  for n in self._pspecs}
+        return params, step

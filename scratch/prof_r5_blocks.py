@@ -1,0 +1,69 @@
+"""Round-5 block sweep with the reworked kernel (diag-split, pre-scaled
+q, emit-once): does 1024 stay the sweet spot at 2K and 32K, and where do
+the clean (uncontended) dense parts land?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+PEAK = 197e12
+from mapreduce_tpu.ops.flash_attention import flash_attention
+
+
+def timed(make_step, x0, n, what, fl, useful_frac=1.0):
+    @jax.jit
+    def prog(x):
+        def body(c, _):
+            return make_step(c), None
+        y, _ = jax.lax.scan(body, x, None, length=n)
+        return y
+
+    r = prog(x0)
+    np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+    best = np.inf
+    for _ in range(4):
+        t0 = time.time()
+        r = prog(x0)
+        np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        best = min(best, time.time() - t0)
+    sec = best / n
+    useful = fl * useful_frac
+    print(f"{what:34s}: {sec*1e3:8.2f} ms/iter  useful "
+          f"{useful/sec/1e12:6.1f} TF/s ({useful/sec/PEAK*100:5.1f}%)",
+          flush=True)
+    return sec
+
+
+def attn_case(B, T, bq, bkv, n):
+    H, D = 8, 128
+    k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+    q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+
+    def loss(x):
+        return jnp.sum(flash_attention(x, k, v, causal=True, block_q=bq,
+                                       block_kv=bkv).astype(jnp.float32))
+
+    def step(x):
+        return (x - 1e-3 * jax.grad(loss)(x)).astype(jnp.bfloat16)
+
+    fl = 6 * 2 * B * H * T * T * D
+    timed(step, q, n, f"attn f+b B{B} T{T} bq{bq} bkv{bkv}", fl, 0.5)
+
+
+# 32K flagship shape
+for bq, bkv in ((1024, 1024), (512, 1024), (256, 1024), (1024, 512),
+                (512, 2048)):
+    try:
+        attn_case(1, 32768, bq, bkv, 48)
+    except Exception as e:
+        print(f"bq{bq} bkv{bkv}: {type(e).__name__} (vmem?)", flush=True)
+# 2K bench shape (B=4)
+for bq, bkv in ((1024, 1024), (512, 1024), (512, 512), (256, 1024)):
+    try:
+        attn_case(4, 2048, bq, bkv, 96)
+    except Exception as e:
+        print(f"bq{bq} bkv{bkv}: {type(e).__name__}", flush=True)

@@ -1,0 +1,29 @@
+"""Validate 64K/128K single-chip training with the round-5 kernel +
+head_dim-128 config (README's remat=True long-context claim)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+from mapreduce_tpu.parallel import make_mesh
+
+for T in (65536, 131072):
+    cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                            n_heads=8, head_dim=128, ffn=4096,
+                            loss_block=2048, remat=True)
+    tr = TransformerTrainer(make_mesh(), cfg, learning_rate=1e-4)
+    params = tr.init_params()
+    toks = np.random.default_rng(0).integers(
+        0, 32768, size=(1, T + 1)).astype(np.int32)
+    t0 = time.time()
+    params, loss = tr.step(params, toks)
+    print(f"T={T}: first step (incl compile) {time.time()-t0:.1f}s "
+          f"loss={float(loss):.3f}", flush=True)
+    t0 = time.time()
+    params, loss = tr.step(params, toks)
+    np.asarray(loss)
+    sec = time.time() - t0
+    print(f"T={T}: steady step {sec:.2f}s = {T/sec/1e3:.1f}k tok/s "
+          f"loss={float(loss):.3f}", flush=True)
+    del params, tr
