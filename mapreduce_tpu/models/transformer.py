@@ -330,7 +330,14 @@ class TransformerTrainer:
     """Jit-compiled sp x tp training step over a ``(model, data)`` mesh."""
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig,
-                 learning_rate: float = 3e-3, seed: int = 0) -> None:
+                 learning_rate: float = 3e-3, seed: int = 0,
+                 optimizer=None) -> None:
+        """``optimizer``: an optax ``GradientTransformation`` (e.g.
+        ``optax.adamw(3e-4)``) or the string ``"adamw"``; None keeps the
+        stateless-SGD fast path.  With an optimizer, use
+        :meth:`init_state` / :meth:`step_opt`, and :meth:`save`
+        (``opt_state=``) / :meth:`load_state` carry the optimizer
+        moments alongside the params."""
         n_model = mesh.shape["model"]
         self.n_data = mesh.shape["data"]
         cfg.validate(n_model)
@@ -384,6 +391,50 @@ class TransformerTrainer:
         self._loss = jax.jit(loss_fn)
         self._pspecs = pspecs
 
+        if isinstance(optimizer, str):
+            import optax
+
+            if optimizer != "adamw":
+                raise ValueError(
+                    f"unknown optimizer string {optimizer!r} (only "
+                    "'adamw'; pass any optax GradientTransformation "
+                    "directly for the rest)")
+            optimizer = optax.adamw(learning_rate)
+        self.tx = optimizer
+        if optimizer is not None:
+            import optax
+
+            def train_step_opt(params, opt_state, tokens, targets):
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, tokens, targets)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss
+
+            self._train_step_opt = jax.jit(train_step_opt,
+                                           donate_argnums=(0, 1))
+
+    def _place_opt_state(self, opt_state):
+        """Pin every optimizer-state leaf to the mesh: leaves living in a
+        params-shaped dict (adamw's mu/nu) take that param's tp sharding;
+        everything else (step counts, scalars) replicates.  tx.init's own
+        placement is NOT mesh-consistent — a fresh scalar lands on one
+        device and poisons the jitted step with mixed device sets."""
+        from jax.tree_util import DictKey, tree_map_with_path
+
+        def place(path, leaf):
+            name = next((p.key for p in reversed(path)
+                         if isinstance(p, DictKey)
+                         and p.key in self._pspecs), None)
+            spec = self._pspecs[name] if name is not None else P()
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        return tree_map_with_path(place, opt_state)
+
+    def _opt_init(self, params):
+        return self._place_opt_state(self.tx.init(params))
+
     def init_params(self) -> Params:
         params = init_transformer(jax.random.key(self.seed), self.cfg)
         return {n: jax.device_put(
@@ -404,6 +455,26 @@ class TransformerTrainer:
         x, y = self.place_batch(tokens)
         return self._train_step(params, x, y)
 
+    # -- optimizer (optax) path -----------------------------------------
+
+    def _need_tx(self):
+        if self.tx is None:
+            raise RuntimeError(
+                "this trainer runs the stateless-SGD path; construct "
+                "with optimizer= for init_state/step_opt/load_state")
+
+    def init_state(self):
+        """-> (params, opt_state) for the optax path (optimizer= set)."""
+        self._need_tx()
+        params = self.init_params()
+        return params, self._opt_init(params)
+
+    def step_opt(self, params: Params, opt_state, tokens: np.ndarray):
+        """One optimizer step; returns (params, opt_state, loss)."""
+        self._need_tx()
+        x, y = self.place_batch(tokens)
+        return self._train_step_opt(params, opt_state, x, y)
+
     # -- checkpointing (the reference's GridFS-serialized trainer role,
     # common.lua:24-39; shares the MLP trainer's atomic npz format) -----
 
@@ -415,8 +486,11 @@ class TransformerTrainer:
         return (f"v{c.vocab}.e{c.embed}.l{c.n_layers}.h{c.n_heads}."
                 f"d{c.head_dim}.f{c.ffn}.moe{c.moe_experts}")
 
-    def save(self, path: str, params: Params, step: int = 0) -> None:
-        """Write an atomic npz (save_checkpoint gathers to host).
+    def save(self, path: str, params: Params, step: int = 0,
+             opt_state=None) -> None:
+        """Write an atomic npz (save_checkpoint gathers to host); pass
+        ``opt_state`` to carry the optimizer moments too (flattened
+        leaves — the treedef is regenerated from tx.init at load).
         Single-controller: under multi-process ``jax.distributed`` the
         shards on other hosts aren't addressable here — gather with
         multihost utils before calling, or save per-process shards."""
@@ -425,19 +499,19 @@ class TransformerTrainer:
         host = dict(params)
         host["__arch__"] = np.frombuffer(
             self._arch_tag().encode(), dtype=np.uint8)
+        if opt_state is not None:
+            for i, leaf in enumerate(jax.tree.leaves(opt_state)):
+                host[f"__opt__{i}"] = leaf
         save_checkpoint(path, host, step)
 
-    def load(self, path: str) -> Tuple[Params, int]:
-        """Load an npz checkpoint and re-place every tensor with its
-        tp-sharding on this trainer's mesh (a checkpoint saved on one
-        mesh layout restores onto another — resharding is just
-        device_put with the new NamedSharding).  Rejects checkpoints
-        whose architecture, param names, shapes, or dtypes don't match
-        this trainer's config — a same-key different-width load must
-        fail HERE, not as a cryptic trace error inside the jitted step."""
+    def _load_host(self, path: str):
+        """-> (validated host params dict, opt leaves, step)."""
         from .trainer import load_checkpoint
 
         host, step = load_checkpoint(path)
+        opt_leaves = [host.pop(k) for k in sorted(
+            (k for k in host if k.startswith("__opt__")),
+            key=lambda k: int(k[len("__opt__"):]))]
         arch = host.pop("__arch__", None)
         if arch is not None:
             got = bytes(bytearray(arch)).decode()
@@ -459,7 +533,43 @@ class TransformerTrainer:
                 "checkpoint params do not match this config (shape/dtype): "
                 + ", ".join(f"{n} {host[n].shape}/{host[n].dtype} vs "
                             f"{ref[n].shape}/{ref[n].dtype}" for n in bad))
-        params = {n: jax.device_put(
-                      host[n], NamedSharding(self.mesh, self._pspecs[n]))
-                  for n in self._pspecs}
-        return params, step
+        return host, opt_leaves, step
+
+    def _place_params(self, host) -> Params:
+        return {n: jax.device_put(
+                    host[n], NamedSharding(self.mesh, self._pspecs[n]))
+                for n in self._pspecs}
+
+    def load(self, path: str) -> Tuple[Params, int]:
+        """Load an npz checkpoint and re-place every tensor with its
+        tp-sharding on this trainer's mesh (a checkpoint saved on one
+        mesh layout restores onto another — resharding is just
+        device_put with the new NamedSharding).  Rejects checkpoints
+        whose architecture, param names, shapes, or dtypes don't match
+        this trainer's config — a same-key different-width load must
+        fail HERE, not as a cryptic trace error inside the jitted step.
+        Optimizer moments, if saved, are ignored here: :meth:`load_state`
+        is the optax-path restore."""
+        host, _, step = self._load_host(path)
+        return self._place_params(host), step
+
+    def load_state(self, path: str):
+        """Optax-path restore: -> (params, opt_state, step).  The
+        opt-state treedef and dtypes come from ``jax.eval_shape`` of
+        ``tx.init`` (no device allocation), then the saved leaves place
+        with the same mesh rules as fresh state; a checkpoint saved
+        without optimizer state resumes with FRESH moments."""
+        self._need_tx()
+        host, leaves, step = self._load_host(path)
+        params = self._place_params(host)
+        if not leaves:
+            return params, self._opt_init(params), step
+        template = jax.eval_shape(self.tx.init, params)
+        t_leaves = jax.tree.leaves(template)
+        if len(leaves) != len(t_leaves):
+            raise ValueError(
+                f"checkpoint optimizer state does not match: "
+                f"{len(leaves)} leaves saved, {len(t_leaves)} expected")
+        cast = [leaf.astype(t.dtype) for leaf, t in zip(leaves, t_leaves)]
+        state = jax.tree.unflatten(jax.tree.structure(template), cast)
+        return params, self._place_opt_state(state), step
