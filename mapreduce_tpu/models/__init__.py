@@ -26,3 +26,4 @@ from .mlp import MLPConfig, init_params, forward, loss_and_accuracy  # noqa: F40
 from .digits import make_digits  # noqa: F401
 from .trainer import TrainConfig, DistributedTrainer  # noqa: F401
 from .pipeline import PipelineConfig, PipelinedTrainer  # noqa: F401
+from .transformer import TransformerConfig, TransformerTrainer  # noqa: F401

@@ -355,8 +355,10 @@ class TransformerTrainer:
         self.mesh, self.cfg, self.lr = mesh, cfg, learning_rate
         self.seed = seed
 
-        pspecs = {n: transformer_param_spec(n)
-                  for n in init_transformer(jax.random.key(0), cfg)}
+        ref = jax.eval_shape(
+            lambda: init_transformer(jax.random.key(0), cfg))
+        pspecs = {n: transformer_param_spec(n) for n in ref}
+        self._pshapes = {n: a.shape for n, a in ref.items()}
         tok_spec = P(None, "data")  # [B, T] sequence-sharded
 
         def sharded_loss(params, tokens, targets):
@@ -427,7 +429,13 @@ class TransformerTrainer:
             name = next((p.key for p in reversed(path)
                          if isinstance(p, DictKey)
                          and p.key in self._pspecs), None)
-            spec = self._pspecs[name] if name is not None else P()
+            # the param spec applies only to EXACT-shape mirrors (adamw
+            # mu/nu); factored states (adafactor v_row/v_col) live under
+            # the same keys with reduced rank — those replicate
+            spec = (self._pspecs[name]
+                    if name is not None
+                    and getattr(leaf, "shape", None) == self._pshapes[name]
+                    else P())
             return jax.device_put(leaf, NamedSharding(self.mesh, spec))
 
         return tree_map_with_path(place, opt_state)
@@ -502,16 +510,23 @@ class TransformerTrainer:
         if opt_state is not None:
             for i, leaf in enumerate(jax.tree.leaves(opt_state)):
                 host[f"__opt__{i}"] = leaf
+            host["__opttree__"] = np.frombuffer(
+                str(jax.tree.structure(opt_state)).encode(),
+                dtype=np.uint8)
         save_checkpoint(path, host, step)
 
     def _load_host(self, path: str):
-        """-> (validated host params dict, opt leaves, step)."""
+        """-> (validated host params dict, opt leaves, opt treedef str
+        or None, step)."""
         from .trainer import load_checkpoint
 
         host, step = load_checkpoint(path)
+        opt_tree = host.pop("__opttree__", None)
         opt_leaves = [host.pop(k) for k in sorted(
             (k for k in host if k.startswith("__opt__")),
             key=lambda k: int(k[len("__opt__"):]))]
+        opt_tree_s = (bytes(bytearray(opt_tree)).decode()
+                      if opt_tree is not None else None)
         arch = host.pop("__arch__", None)
         if arch is not None:
             got = bytes(bytearray(arch)).decode()
@@ -533,7 +548,7 @@ class TransformerTrainer:
                 "checkpoint params do not match this config (shape/dtype): "
                 + ", ".join(f"{n} {host[n].shape}/{host[n].dtype} vs "
                             f"{ref[n].shape}/{ref[n].dtype}" for n in bad))
-        return host, opt_leaves, step
+        return host, opt_leaves, opt_tree_s, step
 
     def _place_params(self, host) -> Params:
         return {n: jax.device_put(
@@ -550,7 +565,7 @@ class TransformerTrainer:
         fail HERE, not as a cryptic trace error inside the jitted step.
         Optimizer moments, if saved, are ignored here: :meth:`load_state`
         is the optax-path restore."""
-        host, _, step = self._load_host(path)
+        host, _, _, step = self._load_host(path)
         return self._place_params(host), step
 
     def load_state(self, path: str):
@@ -560,7 +575,7 @@ class TransformerTrainer:
         with the same mesh rules as fresh state; a checkpoint saved
         without optimizer state resumes with FRESH moments."""
         self._need_tx()
-        host, leaves, step = self._load_host(path)
+        host, leaves, saved_tree, step = self._load_host(path)
         params = self._place_params(host)
         if not leaves:
             return params, self._opt_init(params), step
@@ -570,6 +585,17 @@ class TransformerTrainer:
             raise ValueError(
                 f"checkpoint optimizer state does not match: "
                 f"{len(leaves)} leaves saved, {len(t_leaves)} expected")
+        # treedef attestation: moments from a structurally-DIFFERENT
+        # optimizer are rejected by name (ScaleByAdamState vs
+        # FactoredState ...).  Structurally identical optimizers are
+        # indistinguishable from a pytree — as with any optax/orbax
+        # checkpoint, matching hyperparameters is the caller's contract.
+        want = str(jax.tree.structure(template))
+        if saved_tree is not None and saved_tree != want:
+            raise ValueError(
+                "checkpoint optimizer state does not match this "
+                f"trainer's optimizer: saved {saved_tree}, "
+                f"expected {want}")
         cast = [leaf.astype(t.dtype) for leaf, t in zip(leaves, t_leaves)]
         state = jax.tree.unflatten(jax.tree.structure(template), cast)
         return params, self._place_opt_state(state), step
