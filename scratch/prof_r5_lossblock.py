@@ -1,0 +1,31 @@
+"""loss_block sweep at 32K within ONE process (cross-process chip drift
+makes separate runs incomparable): does a larger cross-entropy chunk
+lift the 32K step?"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from mapreduce_tpu.models.transformer import TransformerConfig, TransformerTrainer
+from mapreduce_tpu.parallel import make_mesh
+
+T = 32768
+toks = np.random.default_rng(0).integers(0, 32768, (1, T + 1)).astype(np.int32)
+for lb in (2048, 4096, 8192):
+    cfg = TransformerConfig(vocab=32768, embed=1024, n_layers=8,
+                            n_heads=8, head_dim=128, ffn=4096,
+                            loss_block=lb)
+    tr = TransformerTrainer(make_mesh(), cfg, learning_rate=1e-4)
+    p = tr.init_params()
+    p, loss = tr.step(p, toks)
+    np.asarray(loss)
+    best = np.inf
+    for _ in range(4):
+        t0 = time.time()
+        for _ in range(3):
+            p, loss = tr.step(p, toks)
+        np.asarray(loss)
+        best = min(best, (time.time() - t0) / 3)
+    print(f"loss_block={lb}: {best:.3f}s/step = {T/best/1e3:.1f}k tok/s",
+          flush=True)
+    del p, tr
