@@ -46,6 +46,48 @@ def _add_auth(p: argparse.ArgumentParser) -> None:
                         "ride the connstr as http://TOKEN@HOST:PORT)")
 
 
+def _add_retry(p: argparse.ArgumentParser) -> None:
+    """Knobs for the networked planes' RetryPolicy (utils/httpclient.py);
+    one flag set governs BOTH sockets — board RPCs and blob transfers.
+    Defaults (when a flag is omitted) are RetryPolicy's."""
+    g = p.add_argument_group("network retry / backoff / circuit breaker")
+    g.add_argument("--retry-attempts", type=int, default=None,
+                   metavar="N", help="max send attempts per call")
+    g.add_argument("--retry-base-delay", type=float, default=None,
+                   metavar="S", help="backoff scale for the first retry "
+                   "(exponential with full jitter after that)")
+    g.add_argument("--retry-max-delay", type=float, default=None,
+                   metavar="S", help="cap on any single backoff sleep")
+    g.add_argument("--retry-deadline", type=float, default=None,
+                   metavar="S", help="whole-call wall-clock budget for "
+                   "BOTH planes (unset: 12s board / 60s blob); keep "
+                   "heartbeat_period + 2*deadline < job lease or healthy "
+                   "workers get fenced")
+    g.add_argument("--breaker-threshold", type=int, default=None,
+                   metavar="N", help="consecutive transport failures that "
+                   "open the circuit (fail fast); 0 disables")
+    g.add_argument("--breaker-cooldown", type=float, default=None,
+                   metavar="S", help="seconds the circuit stays open "
+                   "before a half-open probe")
+
+
+def _retry_policy(args):
+    """Build a RetryPolicy from the _add_retry flags; None (= the module
+    default) when every flag was left at its default."""
+    overrides = {k: v for k, v in (
+        ("max_attempts", args.retry_attempts),
+        ("base_delay", args.retry_base_delay),
+        ("max_delay", args.retry_max_delay),
+        ("deadline", args.retry_deadline),
+        ("breaker_threshold", args.breaker_threshold),
+        ("breaker_cooldown", args.breaker_cooldown)) if v is not None}
+    if not overrides:
+        return None
+    from .utils.httpclient import RetryPolicy
+
+    return RetryPolicy(**overrides)
+
+
 def _setup_logging(verbose: int) -> None:
     level = (logging.WARNING, logging.INFO, logging.DEBUG)[min(verbose, 2)]
     logging.basicConfig(
@@ -69,6 +111,7 @@ def cmd_server(argv: List[str]) -> int:
                    help="JSON passed to every module init()")
     p.add_argument("--result-ns", default=None)
     _add_auth(p)
+    _add_retry(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -91,7 +134,8 @@ def cmd_server(argv: List[str]) -> int:
         params["init_args"] = json.loads(args.init_args)
     if args.result_ns:
         params["result_ns"] = args.result_ns
-    server = Server(args.connstr, args.dbname, auth=args.auth)
+    server = Server(args.connstr, args.dbname, auth=args.auth,
+                    retry=_retry_policy(args))
     server.configure(params)
     stats = server.loop()
     print(json.dumps(stats, default=float))
@@ -108,6 +152,7 @@ def cmd_worker(argv: List[str]) -> int:
     p.add_argument("--max-sleep", type=float, default=None)
     p.add_argument("--max-tasks", type=int, default=None)
     _add_auth(p)
+    _add_retry(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -118,14 +163,15 @@ def cmd_worker(argv: List[str]) -> int:
                               ("max_sleep", args.max_sleep),
                               ("max_tasks", args.max_tasks))
             if v is not None}
+    retry = _retry_policy(args)
     if args.workers == 1:
-        w = Worker(args.connstr, args.dbname, auth=args.auth)
+        w = Worker(args.connstr, args.dbname, auth=args.auth, retry=retry)
         w.configure(conf)
         w.execute()
     else:
         threads = spawn_worker_threads(args.connstr, args.dbname,
                                        args.workers, conf=conf,
-                                       auth=args.auth)
+                                       auth=args.auth, retry=retry)
         for t in threads:
             t.join()
     return 0
@@ -167,12 +213,22 @@ def cmd_wordcount(argv: List[str]) -> int:
     server = Server(connstr, "wc")
     server.configure(params)
     server.loop()
+    wedged = []
     for t in threads:
         t.join(timeout=30)
+        if t.is_alive():
+            wedged.append(t.name)
     from .examples.wordcount import RESULT
     counts = dict(RESULT)
     for word in sorted(counts, key=lambda w: (-counts[w], w)):
         print(counts[word], word)
+    if wedged:
+        # a silent abandon here hides wedged shutdowns (a worker stuck in
+        # a claim/IO call past the FINISHED broadcast); name the stragglers
+        # and fail so operators see it
+        print(f"ERROR: {len(wedged)} worker thread(s) did not exit "
+              f"within 30s: {', '.join(wedged)}", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -31,10 +31,16 @@ class Connection:
     """
 
     def __init__(self, connstr: str, dbname: str,
-                 auth: Optional[Any] = None) -> None:
+                 auth: Optional[Any] = None,
+                 retry: Optional[Any] = None) -> None:
         self.connstr = connstr
         self.dbname = dbname
         self.auth = auth
+        #: RetryPolicy for the networked planes; threaded through to the
+        #: board client (connect) AND to any storage handle opened for a
+        #: job of this connection (job.py), so one CLI flag set governs
+        #: both sockets.  None = httpclient.DEFAULT_RETRY_POLICY.
+        self.retry_policy = retry
         self._store: Optional[DocStore] = None
         # pending batched inserts: coll -> list of (doc, callback)
         self._pending: Dict[str, List[tuple]] = {}
@@ -69,7 +75,8 @@ class Connection:
         """Reference: cnn.lua:34-39 (cached connection, auth on connect)."""
         if self._store is None:
             self._store = docstore.connect(self.connstr,
-                                           auth=self.auth_token())
+                                           auth=self.auth_token(),
+                                           retry=self.retry_policy)
         return self._store
 
     def ns(self, coll: str) -> str:

@@ -18,24 +18,32 @@ the single-document atomicity the in-process backends give
 
 Retry safety: a broken socket mid-request leaves the client unsure
 whether the server applied the op.  Mutating RPCs therefore carry a
-client-generated request id; the server remembers recently answered ids
-and replays the recorded response instead of re-applying — exactly-once
-across one reconnect, so a retried claim cannot double-claim and a
-retried ``$inc`` cannot double-count (the double-apply hazard the blob
-client tolerates only because blob PUTs are idempotent whole-content
-writes, httpstore.py).
+client-generated request id (``SESSION:SEQ``); the server remembers
+recently answered ids and replays the recorded response instead of
+re-applying — exactly-once across any number of reconnect retries, so a
+retried claim cannot double-claim and a retried ``$inc`` cannot
+double-count (the double-apply hazard the blob client tolerates only
+because blob PUTs are idempotent whole-content writes, httpstore.py).
+The remembered-answer cache is bounded (``_DEDUPE_CAP``); when a retry
+straggles in *after* its entry was evicted the server refuses it with
+:class:`DedupeEvictedError` rather than silently re-applying — the
+monotonic per-session seq is what lets it tell that straggler from a
+fresh request.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import http.server
+import itertools
 import json
 import threading
 import uuid
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..utils.httpclient import KeepAliveClient, check_auth, default_auth_token
+from ..utils.httpclient import (
+    KeepAliveClient, RetryPolicy, check_auth, default_auth_token)
 from .docstore import Doc, DocStore, MemoryDocStore, Query
 
 # ops whose second application would change state: answered once, replayed
@@ -44,7 +52,27 @@ _MUTATING_OPS = frozenset(
     {"insert", "insert_many", "update", "find_and_modify", "remove",
      "drop_collection"})
 
-_DEDUPE_CAP = 4096  # answered-request ids remembered per server
+_DEDUPE_CAP = 4096   # answered-request ids remembered per server
+_SESSION_CAP = 1024  # per-client eviction watermarks remembered
+
+
+class DedupeEvictedError(IOError):
+    """A mutating RPC's retry arrived after its dedupe entry was evicted:
+    the server can no longer tell whether the original applied, so it
+    refuses to re-apply and the client must surface the ambiguity instead
+    of silently double-claiming / double-counting."""
+
+
+def _rid_session_seq(rid: str) -> Tuple[Optional[str], Optional[int]]:
+    """Split a ``SESSION:SEQ`` rid; (None, None) for legacy opaque rids
+    (no eviction detection possible for those, matching old behavior)."""
+    session, sep, seq = rid.rpartition(":")
+    if not sep:
+        return None, None
+    try:
+        return session, int(seq)
+    except ValueError:
+        return None, None
 
 
 class _RpcHandler(http.server.BaseHTTPRequestHandler):
@@ -52,6 +80,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     store: DocStore            # set by DocServer
     done: "collections.OrderedDict[str, bytes]"   # rid -> recorded response
     inflight: Dict[str, threading.Event]          # rid -> original executing
+    evicted: "collections.OrderedDict[str, int]"  # session -> max evicted seq
     dedupe_lock: threading.Lock
     auth_token: Optional[str]  # None = open server
 
@@ -93,8 +122,23 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             with self.dedupe_lock:
                 replay = self.done.get(rid)
                 waiter = None if replay is not None else self.inflight.get(rid)
+                stale = False
                 if replay is None and waiter is None:
-                    self.inflight[rid] = threading.Event()
+                    session, seq = _rid_session_seq(rid)
+                    if (session is not None and seq is not None
+                            and seq <= self.evicted.get(session, -1)):
+                        # straggling retry of an EVICTED entry: the answer
+                        # is gone, so whether the original applied is
+                        # unknowable — refuse loudly, never re-apply
+                        stale = True
+                    else:
+                        self.inflight[rid] = threading.Event()
+            if stale:
+                return self._respond(200, json.dumps(
+                    {"ok": False, "type": "DedupeEvictedError",
+                     "error": f"rid {rid}: retry arrived after its dedupe "
+                              "entry was evicted; cannot guarantee "
+                              "exactly-once"}).encode())
             if replay is not None:
                 return self._respond(200, replay)
             if waiter is not None:
@@ -126,7 +170,18 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                     if body is not None:  # BaseException: leave unrecorded
                         self.done[rid] = body
                         while len(self.done) > _DEDUPE_CAP:
-                            self.done.popitem(last=False)
+                            old_rid, _ = self.done.popitem(last=False)
+                            # remember the high-water mark of evicted seqs
+                            # per session so a straggler can be refused
+                            # instead of re-applied (seqs are monotonic
+                            # per session, so max == newest evicted)
+                            s, q = _rid_session_seq(old_rid)
+                            if s is not None and q is not None:
+                                self.evicted[s] = max(
+                                    q, self.evicted.get(s, -1))
+                                self.evicted.move_to_end(s)
+                                while len(self.evicted) > _SESSION_CAP:
+                                    self.evicted.popitem(last=False)
                 if ev is not None:
                     ev.set()
         self._respond(200, body)
@@ -176,6 +231,7 @@ class DocServer:
             "store": store if store is not None else MemoryDocStore(),
             "done": collections.OrderedDict(),
             "inflight": {},
+            "evicted": collections.OrderedDict(),
             "dedupe_lock": threading.Lock(),
             "auth_token": default_auth_token(auth_token),
         })
@@ -209,24 +265,39 @@ class HttpDocStore(DocStore):
 
     One keep-alive connection per handle, serialized by a lock (a worker's
     claim loop and its heartbeat thread share the handle); re-established
-    once on a broken socket, with the request id making the retry
-    exactly-once for mutating ops.
+    on a broken socket under the client's :class:`RetryPolicy`, with the
+    request id making every re-send exactly-once for mutating ops.  The
+    rid is ``SESSION:SEQ`` — a per-handle session plus a monotonic
+    sequence — so the server can tell a straggling retry of an *evicted*
+    dedupe entry from a fresh request and fail it loudly instead of
+    silently re-applying (see ``_RpcHandler``).
     """
 
     def __init__(self, address: str,
-                 auth_token: Optional[str] = None) -> None:
+                 auth_token: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None) -> None:
         self._client = KeepAliveClient.from_address(
-            address, what="http docstore", auth_token=auth_token)
+            address, what="http docstore", auth_token=auth_token,
+            retry=retry)
         self.host, self.port = self._client.host, self._client.port
+        self._rid_session = uuid.uuid4().hex
+        self._rid_seq = itertools.count(1)
+        # serializes rid allocation WITH the send: the eviction watermark
+        # assumes this session's seqs arrive in order, so two threads
+        # sharing the handle (claim loop + heartbeat) must not allocate
+        # seqs in one order and win the client's send lock in the other
+        self._mutate_lock = threading.Lock()
 
     def _rpc(self, op: str, **fields: Any) -> Any:
         payload: Dict[str, Any] = {"op": op, **fields}
-        if op in _MUTATING_OPS:
-            payload["rid"] = uuid.uuid4().hex
-        body = json.dumps(payload).encode()
-        status, raw = self._client.request(
-            "POST", "/rpc", body=body,
-            headers={"Content-Type": "application/json"})
+        mutating = op in _MUTATING_OPS
+        with self._mutate_lock if mutating else contextlib.nullcontext():
+            if mutating:
+                payload["rid"] = (f"{self._rid_session}:"
+                                  f"{next(self._rid_seq)}")
+            status, raw = self._client.request(
+                "POST", "/rpc", body=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
         if status == 401:
             raise PermissionError(
                 f"docstore rpc {op!r}: auth rejected by "
@@ -239,6 +310,7 @@ class HttpDocStore(DocStore):
             exc_type = {"ValueError": ValueError, "KeyError": KeyError,
                         "TypeError": TypeError,
                         "PermissionError": PermissionError,
+                        "DedupeEvictedError": DedupeEvictedError,
                         }.get(reply.get("type"), IOError)
             raise exc_type(reply.get("error", "rpc failed"))
         return reply["result"]

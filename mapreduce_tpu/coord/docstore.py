@@ -468,7 +468,8 @@ class _DirLock:
         return False
 
 
-def connect(connstr: str, auth: Optional[str] = None) -> DocStore:
+def connect(connstr: str, auth: Optional[str] = None,
+            retry=None) -> DocStore:
     """Open a store from a connection string (reference: a mongod host:port,
     utils.lua:62-69).  Forms:
 
@@ -481,6 +482,9 @@ def connect(connstr: str, auth: Optional[str] = None) -> DocStore:
         ``auth`` is the bearer token for an auth-required server
         (reference: the ``auth_table`` arg of cnn.lua:106-113); it can
         also ride the connstr or $MAPREDUCE_TPU_AUTH (httpclient.py).
+        ``retry`` is an optional :class:`~..utils.httpclient.RetryPolicy`
+        for the networked backend (ignored by the local ones, which have
+        no wire to fail).
     """
     if connstr.startswith("mem://"):
         return MemoryDocStore.named(connstr[len("mem://"):])
@@ -488,7 +492,8 @@ def connect(connstr: str, auth: Optional[str] = None) -> DocStore:
         return DirDocStore(connstr[len("dir://"):])
     if connstr.startswith("http://"):
         from .docserver import HttpDocStore
-        return HttpDocStore(connstr[len("http://"):], auth_token=auth)
+        return HttpDocStore(connstr[len("http://"):], auth_token=auth,
+                            retry=retry)
     if connstr.startswith("/"):
         return DirDocStore(connstr)
     raise ValueError(

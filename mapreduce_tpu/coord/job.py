@@ -21,6 +21,7 @@ Intended-behavior decisions where the reference is quirky (SURVEY.md §7):
 
 from __future__ import annotations
 
+import logging
 import re
 import time
 import urllib.parse
@@ -35,6 +36,8 @@ from ..utils.serialization import (
 from .. import storage as storage_mod
 from . import docstore
 from .connection import Connection
+
+logger = logging.getLogger("mapreduce_tpu.coord.job")
 
 
 def sanitize_token(s: str) -> str:
@@ -79,14 +82,23 @@ class Job:
 
     def __init__(self, connection: Connection, job_tbl: Dict[str, Any],
                  task_status: TASK_STATUS, task_tbl: Dict[str, Any],
-                 jobs_ns: str) -> None:
+                 jobs_ns: str, fence: Optional[Any] = None) -> None:
         self._cnn = connection
         self.tbl = job_tbl
         self.task_status = task_status
         self.task_tbl = task_tbl
         self.jobs_ns = jobs_ns
-        self._storage = storage_mod.router(task_tbl["storage"],
-                                           auth=connection.auth_token())
+        #: threading.Event set by the worker's heartbeat thread when this
+        #: claim's lease is confirmed lost; checked at every emit and
+        #: before each output-publish / write-back step, so a fenced run
+        #: aborts instead of racing the re-issued copy.  A publish
+        #: already in flight when the fence drops may still land (benign:
+        #: per-job-named atomic whole-content files); the hard guarantee
+        #: is the claim-guarded job-document write-back.
+        self._fence = fence
+        self._storage = storage_mod.router(
+            task_tbl["storage"], auth=connection.auth_token(),
+            retry=getattr(connection, "retry_policy", None))
         self.path = task_tbl["path"]
         #: files consumed by a reduce run, deleted only once WRITTEN is
         #: durable (a re-run of a crashed reduce must still find them)
@@ -128,11 +140,26 @@ class Job:
     def mark_as_broken(self) -> None:
         """BROKEN + $inc repetitions; claimable again (job.lua:322-342).
         Guarded by the claim so a stale worker can't re-break a job another
-        worker has since reclaimed."""
+        worker has since reclaimed, and by status so a post-completion
+        failure (e.g. cleanup I/O) can never demote a durably WRITTEN job
+        back to claimable."""
         self._cnn.connect().update(
-            self.jobs_ns, self._claim_query(),
+            self.jobs_ns,
+            {**self._claim_query(),
+             "status": {"$nin": [int(STATUS.WRITTEN),
+                                 int(STATUS.FAILED)]}},
             {"$set": {"status": int(STATUS.BROKEN)},
              "$inc": {"repetitions": 1}})
+
+    def _check_fence(self) -> None:
+        """Abort if the heartbeat thread has confirmed lease loss; called
+        from emit (so a long user fn dies at its next emission) and before
+        each output-visibility step."""
+        if self._fence is not None and self._fence.is_set():
+            from .task import LeaseLostError
+            raise LeaseLostError(
+                f"job {self.get_id()}: lease lost (reaped or reclaimed); "
+                "aborting this run — the re-issued copy owns the job now")
 
     # -- user-fn plumbing --------------------------------------------------
 
@@ -174,15 +201,25 @@ class Job:
                     f"job in task status {self.task_status}")
         finally:
             restore_ambient_auth(prev_auth)
+        self._check_fence()
         owned = self.mark_as_written(time.process_time() - t_cpu,
                                      time.time() - t_real)
         # delete consumed map files only once WRITTEN is durable AND this
         # claim still owned the job (a reaped+reclaimed job's files belong
         # to the new owner's re-run); reference deletes pre-write,
         # job.lua:293, which loses the partition if the worker dies between
-        # build and write-back
+        # build and write-back.  A cleanup failure must NOT escape: the job
+        # is already durably WRITTEN, and letting a storage blip bubble to
+        # the worker's shield would demote a completed job to BROKEN — a
+        # forced duplicate execution whose inputs may be partially deleted.
         if owned and self._consumed:
-            self._storage.remove_many(self._consumed)
+            try:
+                self._storage.remove_many(self._consumed)
+            except OSError:
+                logger.warning(
+                    "job %s: WRITTEN but consumed-input cleanup failed; "
+                    "leaving orphan map files behind", self.get_id(),
+                    exc_info=True)
         self._consumed = []
 
     def _execute_map(self) -> None:
@@ -195,6 +232,7 @@ class Job:
         keyorder: Dict[Any, Any] = {}
 
         def emit(key: Any, value: Any) -> None:
+            self._check_fence()
             sk = sort_key(key)
             bucket = result.setdefault(sk, [])
             keyorder.setdefault(sk, key)
@@ -224,6 +262,7 @@ class Job:
 
         ns = map_results_prefix(self.path)
         for part, lines in per_part.items():
+            self._check_fence()
             b = self._storage.builder()
             for line in lines:
                 b.write_record_line(line)
@@ -245,6 +284,7 @@ class Job:
         ]
         b = self._storage.builder()
         for key, values in merge_iterator(sources):
+            self._check_fence()
             # ACI fast path: a single value needs no reduce call
             # (job.lua:264-284)
             if aci and len(values) == 1:

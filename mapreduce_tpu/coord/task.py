@@ -31,6 +31,15 @@ TaskDoc = Dict[str, Any]
 JobDoc = Dict[str, Any]
 
 
+class LeaseLostError(RuntimeError):
+    """This worker's claim on the running job is gone (lease reaped after
+    a partition outlasted it, or the job re-issued to another worker).
+    Raised inside the job's execution path to abort it — the re-issued
+    copy is now the authoritative run, and finishing here would race it
+    (duplicate user-fn side effects, the window Dean & Ghemawat close by
+    committing map output atomically; we close it at the source)."""
+
+
 def make_job(key: Any, value: Any) -> JobDoc:
     """Build a claimable job document (reference utils.make_job:87-98)."""
     return {
@@ -211,22 +220,36 @@ class Task:
         self._idle_count += 1
         return None, st
 
-    def heartbeat(self, job_tbl: JobDoc) -> None:
+    def heartbeat(self, job_tbl: JobDoc) -> bool:
         """Extend an in-flight job's lease (no reference equivalent — fixes
         the missing dead-worker detection, SURVEY.md §5).  Guarded by the
         claim identity so a stale worker can't extend a lease that now
         belongs to another worker's claim.  Matches both RUNNING and
         FINISHED: a map job is FINISHED while its worker is still writing
         output files (job.py), and that write phase must keep the lease
-        alive too."""
-        self._cnn.connect().update(
+        alive too.
+
+        Returns whether this claim still OWNS the job.  False means the
+        lease was lost for certain — the server reaped it to BROKEN (a
+        partition outlasted ``job_lease``) or another worker has since
+        reclaimed it — and the caller must fence: abort the running job
+        instead of racing the re-issued copy (the answer arrived over a
+        working RPC, so False is knowledge, not a guess; a *network*
+        failure raises instead and proves nothing either way).  WRITTEN
+        is matched too: a beat racing this claim's own just-completed
+        write must report ownership, not a spurious loss (the lease
+        extension on a terminal doc is inert — the reaper only looks at
+        RUNNING/FINISHED)."""
+        n = self._cnn.connect().update(
             self.jobs_ns(),
             {"_id": job_tbl["_id"],
              "worker": job_tbl.get("worker"),
              "tmpname": job_tbl.get("tmpname"),
              "status": {"$in": [int(STATUS.RUNNING),
-                                int(STATUS.FINISHED)]}},
+                                int(STATUS.FINISHED),
+                                int(STATUS.WRITTEN)]}},
             {"$set": {"lease_expires": docstore.now() + self.job_lease}})
+        return n > 0
 
     def reap_expired(self, coll: str) -> int:
         """Server-side: in-flight jobs (RUNNING, or FINISHED — user fn done

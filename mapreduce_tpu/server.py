@@ -41,8 +41,9 @@ class Server:
 
     def __init__(self, connstr: str, dbname: str,
                  auth: Optional[Any] = None,
-                 job_lease: Optional[float] = None) -> None:
-        self.cnn = Connection(connstr, dbname, auth)
+                 job_lease: Optional[float] = None,
+                 retry: Optional[Any] = None) -> None:
+        self.cnn = Connection(connstr, dbname, auth, retry=retry)
         self.task = Task(self.cnn, **(
             {"job_lease": job_lease} if job_lease is not None else {}))
         self.params: Dict[str, Any] = {}
@@ -157,7 +158,8 @@ class Server:
 
     def _prepare_reduce(self) -> int:
         storage = storage_mod.router(self.params["storage"],
-                                     auth=self.cnn.auth_token())
+                                     auth=self.cnn.auth_token(),
+                                     retry=self.cnn.retry_policy)
         ns = map_results_prefix(self.params["path"])
         # group map result files by partition token P<nnnnn>
         # (server.lua:291-312)
@@ -249,7 +251,8 @@ class Server:
         # cleared first — _result_pairs merges every result.P* file, so a
         # leftover P00001 would silently blend into the device output
         storage = storage_mod.router(self.params["storage"],
-                                     auth=self.cnn.auth_token())
+                                     auth=self.cnn.auth_token(),
+                                     retry=self.cnn.retry_policy)
         storage.remove_many(self._result_partitions(storage))
         b = storage.builder()
         for key, values in sorted(out_pairs,
@@ -335,7 +338,8 @@ class Server:
 
     def _final(self) -> Any:
         storage = storage_mod.router(self.params["storage"],
-                                     auth=self.cnn.auth_token())
+                                     auth=self.cnn.auth_token(),
+                                     retry=self.cnn.retry_policy)
         finalfn = spec.load_role(self.params["finalfn"], "finalfn")
         reply = finalfn.fn(self._result_pairs(storage))
         if reply not in (True, False, None, "loop"):

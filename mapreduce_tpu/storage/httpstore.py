@@ -23,7 +23,9 @@ import threading
 import urllib.parse
 from typing import Iterator, List, Optional, Tuple
 
-from ..utils.httpclient import KeepAliveClient, check_auth, default_auth_token
+from ..utils.httpclient import (
+    KeepAliveClient, RetryPolicy, blob_policy, check_auth,
+    default_auth_token)
 from .base import Storage
 from .localdir import LocalDirStorage
 
@@ -162,19 +164,22 @@ class HttpStorage(Storage):
     scheme = "http"
 
     def __init__(self, address: str,
-                 auth_token: Optional[str] = None) -> None:
+                 auth_token: Optional[str] = None,
+                 retry: Optional["RetryPolicy"] = None) -> None:
         self._client = KeepAliveClient.from_address(
-            address, what="http storage", auth_token=auth_token)
+            address, what="http storage", auth_token=auth_token,
+            retry=blob_policy(retry))
         self.host, self.port = self._client.host, self._client.port
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None,
                  headers: Optional[dict] = None) -> Tuple[int, bytes]:
-        """The KeepAliveClient retry is blind (the first attempt may have
-        been applied before the socket broke), which is safe ONLY because
-        every mutating blob endpoint is idempotent: PUT publishes whole
-        content atomically and DELETE converges.  A future non-idempotent
-        endpoint must not ride this path — give it request-id dedupe like
-        the docserver's mutating RPCs (coord/docserver.py)."""
+        """The KeepAliveClient re-sends blindly under its RetryPolicy (any
+        attempt may have been applied before its socket broke), which is
+        safe ONLY because every mutating blob endpoint is idempotent: PUT
+        publishes whole content atomically and DELETE converges.  A future
+        non-idempotent endpoint must not ride this path — give it
+        request-id dedupe like the docserver's mutating RPCs
+        (coord/docserver.py)."""
         status, body_out = self._client.request(method, path, body=body,
                                                 headers=headers)
         if status == 401:

@@ -44,7 +44,7 @@ def get_storage_from(storage: str = None) -> Tuple[str, str]:
     return backend, path
 
 
-def router(storage: str = None, auth: str = None) -> Storage:
+def router(storage: str = None, auth: str = None, retry=None) -> Storage:
     """Open the backend named by a DSL string (fs.router, fs.lua:185-208).
 
     ``auth`` is the bearer token for an auth-required blobserver behind
@@ -59,5 +59,5 @@ def router(storage: str = None, auth: str = None) -> Storage:
         return MemoryStorage.named(path)
     if backend == "http":
         from .httpstore import HttpStorage
-        return HttpStorage(path, auth_token=auth)
+        return HttpStorage(path, auth_token=auth, retry=retry)
     return LocalDirStorage(path)

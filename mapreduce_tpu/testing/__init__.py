@@ -1,0 +1,8 @@
+"""Test-support subpackage: network fault injection for the two HTTP
+planes (testing/faults.py).  Ships inside the package — not under tests/
+— so deployments can chaos-test a live topology with the same harness CI
+uses (Basiri et al., "Chaos Engineering", IEEE Software 2016)."""
+
+from .faults import FaultProxy, FaultRule, FaultSchedule
+
+__all__ = ["FaultProxy", "FaultRule", "FaultSchedule"]

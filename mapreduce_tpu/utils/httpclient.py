@@ -2,10 +2,19 @@
 
 One persistent connection per handle (both services speak HTTP/1.1),
 serialized by a lock (a worker's claim loop and its heartbeat thread share
-one handle), re-established once on a stale/broken socket.  Used by the
-blob client (storage/httpstore.py) and the doc client (coord/docserver.py);
-whether the single blind retry is SAFE is the caller's contract — blob
-endpoints are idempotent, docstore mutations carry request-id dedupe.
+one handle), re-established on a stale/broken socket.  Used by the
+blob client (storage/httpstore.py) and the doc client (coord/docserver.py).
+
+Retries are governed by a :class:`RetryPolicy` — exponential backoff with
+full jitter (the AWS-architecture-blog shape: sleep ~ U(0, min(cap,
+base*2^n))), a per-call deadline budget, retryable-status classification
+(429/502/503/504 re-send; anything else is the caller's answer), and a
+circuit breaker that fails fast once an endpoint has produced
+``breaker_threshold`` consecutive transport failures instead of making
+every caller eat a full connect timeout.  Whether re-sending is SAFE is
+still the caller's contract — blob endpoints are idempotent whole-content
+ops, docstore mutations carry a request id the server dedupes across any
+number of re-sends (coord/docserver.py).
 
 Auth is a shared-secret bearer token, the role mongod's user/password
 auth plays for the reference (cnn.lua:34-39 passes ``auth_table`` to
@@ -28,13 +37,141 @@ test uses).
 
 from __future__ import annotations
 
+import dataclasses
 import hmac
 import http.client
 import os
+import random
 import threading
-from typing import Dict, Optional, Tuple
+import time
+from typing import Dict, FrozenSet, Optional, Tuple
 
 AUTH_ENV = "MAPREDUCE_TPU_AUTH"
+
+
+class RetryError(IOError):
+    """Every attempt failed (or the deadline budget ran out); the original
+    transport error rides along as ``__cause__``."""
+
+
+class CircuitOpenError(ConnectionError):
+    """The endpoint's circuit breaker is open: recent attempts all failed
+    at the transport level, so this call fails fast instead of eating a
+    connect timeout.  The breaker half-opens after ``breaker_cooldown``
+    seconds and lets one probe through."""
+
+
+#: HTTP statuses worth re-sending the request for: transient server-side
+#: refusals (overload shedding, a proxy with a dead upstream).  4xx other
+#: than 429 and genuine 5xx application errors (500) are answers, not
+#: transients — they go back to the caller.
+RETRYABLE_STATUSES: FrozenSet[int] = frozenset({429, 502, 503, 504})
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How a :class:`KeepAliveClient` call behaves under failure.
+
+    ``max_attempts`` bounds re-sends, ``deadline`` bounds the whole call's
+    wall clock (backoff sleeps are clipped to what remains — the call
+    never sleeps past its own budget), backoff is exponential with full
+    jitter so a fleet of workers retrying a recovered endpoint doesn't
+    stampede it in lockstep.  The circuit breaker counts *consecutive*
+    transport-level failures; at ``breaker_threshold`` it opens and calls
+    fail fast with :class:`CircuitOpenError` until ``breaker_cooldown``
+    elapses, when one half-open probe is allowed through (success closes
+    the breaker, failure re-opens it).  ``breaker_threshold=0`` disables
+    the breaker.
+    """
+
+    max_attempts: int = 5
+    base_delay: float = 0.05       # first-retry backoff scale, seconds
+    max_delay: float = 2.0         # backoff cap per sleep
+    #: whole-call wall-clock budget; None = the calling plane's default
+    #: (BOARD_DEADLINE for the board, BLOB_DEADLINE via blob_policy for
+    #: bulk blob transfers).  An explicit number is the user's word for
+    #: every plane the policy reaches.
+    deadline: Optional[float] = None
+    retry_statuses: FrozenSet[int] = RETRYABLE_STATUSES
+    breaker_threshold: int = 5     # consecutive failures to open; 0 = off
+    breaker_cooldown: float = 1.0  # seconds open before a half-open probe
+
+    def backoff(self, attempt: int) -> float:
+        """Full-jitter sleep before retry *attempt* (attempt >= 1)."""
+        cap = min(self.max_delay, self.base_delay * (2 ** (attempt - 1)))
+        return random.uniform(0.0, cap)
+
+
+#: board-plane deadline used when RetryPolicy.deadline is None.  Sized
+#: against DEFAULT_JOB_LEASE (30s): a worker's heartbeat shares its
+#: handle lock with job RPCs, so between successful lease extensions the
+#: worst case is one beat period (5s) + a full job-RPC deadline spent
+#: waiting on the lock + the heartbeat's own deadline — 5 + 2*12 = 29s
+#: < 30s.  A bigger value would let a healthy-but-slow board call starve
+#: the heartbeat past the lease and get its own job reaped and fenced;
+#: raise job_lease in step if you raise a deadline past this.
+BOARD_DEADLINE = 12.0
+
+#: blob-plane deadline used when RetryPolicy.deadline is None: blob
+#: sockets have no heartbeat-lock/lease coupling, and bulk transfers
+#: keep the 60s-scale budget the old client's socket timeout gave them.
+BLOB_DEADLINE = 60.0
+
+#: module default, shared by every client not given an explicit policy.
+DEFAULT_RETRY_POLICY = RetryPolicy()
+
+#: default for the BLOB plane (storage/httpstore.py).
+BLOB_RETRY_POLICY = dataclasses.replace(DEFAULT_RETRY_POLICY,
+                                        deadline=BLOB_DEADLINE)
+
+
+def blob_policy(policy: Optional[RetryPolicy]) -> RetryPolicy:
+    """Blob-plane variant of a (possibly user-tuned) policy: a deadline
+    left unset (None) resolves to BLOB_DEADLINE instead of the tighter
+    board default; an explicit deadline — even one equal to a default —
+    is the user's word for both planes and passes through untouched."""
+    if policy is None:
+        return BLOB_RETRY_POLICY
+    if policy.deadline is None:
+        return dataclasses.replace(policy, deadline=BLOB_DEADLINE)
+    return policy
+
+
+class _Breaker:
+    """Per-endpoint circuit breaker state (thread-safe; one per client
+    handle, which the docstore/blob planes each keep per endpoint)."""
+
+    def __init__(self, policy: RetryPolicy) -> None:
+        self._policy = policy
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+
+    def allow(self) -> bool:
+        if self._policy.breaker_threshold <= 0:
+            return True
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if (time.monotonic() - self._opened_at
+                    >= self._policy.breaker_cooldown):
+                # half-open: let this probe through; a failure re-opens
+                # (record_failure re-stamps opened_at), a success closes
+                return True
+            return False
+
+    def record_failure(self) -> None:
+        if self._policy.breaker_threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive += 1
+            if self._consecutive >= self._policy.breaker_threshold:
+                self._opened_at = time.monotonic()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._opened_at = None
 
 def split_embedded_token(address: str):
     """``[TOKEN@]HOST:PORT`` -> ``(token_or_None, "HOST:PORT")`` — the one
@@ -108,8 +245,10 @@ def check_auth(token: Optional[str], headers) -> bool:
 
 class KeepAliveClient:
     def __init__(self, host: str, port: int, timeout: float = 60.0,
-                 auth_token: Optional[str] = None) -> None:
+                 auth_token: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None) -> None:
         self.host, self.port, self.timeout = host, port, timeout
+        self.retry = retry if retry is not None else DEFAULT_RETRY_POLICY
         if auth_token is not None:
             self.auth_token = auth_token or None
         else:  # ambient (scoped to this endpoint) beats the env var
@@ -117,11 +256,14 @@ class KeepAliveClient:
                                or default_auth_token())
         self._cnn: Optional[http.client.HTTPConnection] = None
         self._lock = threading.Lock()
+        self._breaker = _Breaker(self.retry)
 
     @classmethod
     def from_address(cls, address: str, timeout: float = 60.0,
                      what: str = "http endpoint",
-                     auth_token: Optional[str] = None) -> "KeepAliveClient":
+                     auth_token: Optional[str] = None,
+                     retry: Optional[RetryPolicy] = None,
+                     ) -> "KeepAliveClient":
         """Parse ``[TOKEN@]HOST:PORT`` via :func:`split_embedded_token`.
         An embedded token loses to an explicit ``auth_token=`` but beats
         ambient and environment."""
@@ -135,31 +277,93 @@ class KeepAliveClient:
             port_n = 0
         if not host or not port or port_n <= 0:
             raise ValueError(f"{what} wants HOST:PORT, got {address!r}")
-        return cls(host, port_n, timeout, auth_token=auth_token)
+        return cls(host, port_n, timeout, auth_token=auth_token, retry=retry)
 
     def request(self, method: str, path: str,
                 body: Optional[bytes] = None,
                 headers: Optional[Dict[str, str]] = None,
                 ) -> Tuple[int, bytes]:
+        """Send one HTTP request under the retry policy.
+
+        Re-sending the identical bytes is what makes N retries no worse
+        than one: docstore mutations keep their request id across every
+        re-send (the server replays the recorded answer), blob mutations
+        are idempotent whole-content ops.  Serialized under the handle
+        lock, so a backoff sleep also delays the other threads sharing
+        this handle — the deadline budget bounds how long.
+        """
         headers = dict(headers or {})
         if self.auth_token is not None:
             headers.setdefault("Authorization", f"Bearer {self.auth_token}")
+        policy = self.retry
         with self._lock:
-            for attempt in (0, 1):
-                if self._cnn is None:
-                    self._cnn = http.client.HTTPConnection(
-                        self.host, self.port, timeout=self.timeout)
+            # the breaker gates ADMISSION of a call, not attempts within
+            # one: a call admitted while the circuit was closed keeps its
+            # whole attempt/deadline budget even if its own failures trip
+            # the threshold mid-flight (otherwise max_attempts >
+            # breaker_threshold would be unreachable configuration)
+            if not self._breaker.allow():
+                raise CircuitOpenError(
+                    f"{self.host}:{self.port} circuit open "
+                    f"(>= {policy.breaker_threshold} consecutive "
+                    f"failures; retrying after "
+                    f"{policy.breaker_cooldown}s cooldown)")
+            deadline = (policy.deadline if policy.deadline is not None
+                        else BOARD_DEADLINE)
+            give_up_at = time.monotonic() + deadline
+            last_exc: Optional[BaseException] = None
+            last_status: Optional[int] = None
+            for attempt in range(max(policy.max_attempts, 1)):
+                if attempt:
+                    pause = min(policy.backoff(attempt),
+                                give_up_at - time.monotonic())
+                    if pause > 0:
+                        time.sleep(pause)
+                remaining = give_up_at - time.monotonic()
+                if attempt and remaining <= 0:
+                    break
+                # the deadline bounds the WHOLE call, so it also clips this
+                # attempt's socket wait — a blackholed endpoint costs at
+                # most the remaining budget, never the full socket timeout
+                attempt_timeout = max(min(self.timeout, remaining), 0.001)
                 try:
+                    if self._cnn is None:
+                        self._cnn = http.client.HTTPConnection(
+                            self.host, self.port, timeout=attempt_timeout)
+                    # refresh BOTH timeouts on a kept handle: .timeout
+                    # governs an implicit reconnect (sock=None after a
+                    # server-sent Connection: close), .settimeout the
+                    # live socket — else a handle created late in some
+                    # earlier call keeps that call's clipped budget
+                    self._cnn.timeout = attempt_timeout
+                    if self._cnn.sock is not None:
+                        self._cnn.sock.settimeout(attempt_timeout)
                     self._cnn.request(method, path, body=body,
                                       headers=headers)
                     r = self._cnn.getresponse()
-                    return r.status, r.read()
-                except (http.client.HTTPException, OSError):
+                    status, data = r.status, r.read()
+                except (http.client.HTTPException, OSError) as exc:
                     self._cnn.close()
                     self._cnn = None
-                    if attempt:
-                        raise
-            raise AssertionError("unreachable")
+                    self._breaker.record_failure()
+                    last_exc, last_status = exc, None
+                    continue
+                self._breaker.record_success()
+                if status in policy.retry_statuses:
+                    # transient server-side refusal: drop the connection
+                    # (a 503-ing hop may have poisoned the keep-alive
+                    # stream) and re-send after backoff
+                    self._cnn.close()
+                    self._cnn = None
+                    last_exc, last_status = None, status
+                    continue
+                return status, data
+            msg = (f"{method} {path} to {self.host}:{self.port} failed "
+                   f"after {policy.max_attempts} attempts / "
+                   f"{deadline}s deadline")
+            if last_status is not None:
+                msg += f" (last: HTTP {last_status})"
+            raise RetryError(msg) from last_exc
 
     def close(self) -> None:
         with self._lock:

@@ -2,10 +2,14 @@
 
 Claims jobs from the task's job board, runs them under an exception shield
 that marks the job BROKEN and reports to the errors channel, backs off
-exponentially when idle, and self-terminates after too many distinct
+exponentially when idle, and self-terminates after too many CONSECUTIVE
 failures (worker.lua:42-138, call stack SURVEY.md §3.2).  New vs the
 reference: a heartbeat thread extends the RUNNING job's lease so the server
-can distinguish slow workers from dead ones (SURVEY.md §5 gap).
+can distinguish slow workers from dead ones (SURVEY.md §5 gap) — and the
+heartbeat doubles as the fencing probe: when it learns the lease is LOST
+(reaped after a partition outlasted ``job_lease``, or re-issued to another
+worker) it fences the running job, which aborts at its next emit/output
+step instead of racing the re-issued copy (coord/task.LeaseLostError).
 """
 
 from __future__ import annotations
@@ -17,7 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from .coord.connection import Connection
 from .coord.job import Job
-from .coord.task import Task
+from .coord.task import LeaseLostError, Task
 from .utils.constants import (
     TASK_STATUS, DEFAULT_SLEEP, DEFAULT_MAX_SLEEP, DEFAULT_MAX_ITER,
     DEFAULT_MAX_TASKS, DEFAULT_HEARTBEAT, MAX_WORKER_RETRIES)
@@ -30,8 +34,9 @@ class Worker:
 
     def __init__(self, connstr: str, dbname: str,
                  auth: Optional[Any] = None,
-                 name: Optional[str] = None) -> None:
-        self.cnn = Connection(connstr, dbname, auth)
+                 name: Optional[str] = None,
+                 retry: Optional[Any] = None) -> None:
+        self.cnn = Connection(connstr, dbname, auth, retry=retry)
         self.task = Task(self.cnn)
         self.name = name or f"{Connection.hostname()}-{id(self):x}"
         self.max_iter = DEFAULT_MAX_ITER
@@ -40,6 +45,9 @@ class Worker:
         self.sleep = DEFAULT_SLEEP
         self.heartbeat_period = DEFAULT_HEARTBEAT
         self.jobs_done = 0
+        #: fence of the most recently started job — observable so
+        #: tests/operators can see a fencing in flight
+        self.current_fence: Optional[threading.Event] = None
 
     def configure(self, conf: Dict[str, Any]) -> None:
         """worker.lua:142-148: max_iter / max_sleep / max_tasks knobs."""
@@ -49,15 +57,33 @@ class Worker:
 
     # -- one job under heartbeat ------------------------------------------
 
-    def _run_job(self, job: Job) -> None:
+    def _run_job(self, job: Job, fence: threading.Event) -> None:
         stop = threading.Event()
 
         def beat() -> None:
             while not stop.wait(self.heartbeat_period):
                 try:
-                    self.task.heartbeat(job.tbl)
-                except Exception:  # heartbeat must never kill the job
+                    owned = self.task.heartbeat(job.tbl)
+                except Exception:
+                    # network failure: ownership is UNKNOWN (the lease may
+                    # still be live server-side), so keep beating — fencing
+                    # on a guess would abort healthy jobs during a blip
                     logger.exception("heartbeat failed")
+                    continue
+                if not owned and not stop.is_set():
+                    # (the heartbeat query matches this claim's WRITTEN
+                    # too, so completion races report ownership; the stop
+                    # check is a second belt for shutdown edges)
+                    # the server answered and the claim no longer matches:
+                    # lease reaped (partition outlasted job_lease,
+                    # task.reap_expired) or the job was re-issued.  Fence:
+                    # the running job aborts at its next emit/output step
+                    # instead of racing the new owner.
+                    logger.warning(
+                        "%s: lease lost on job %s — fencing this run",
+                        self.name, job.get_id())
+                    fence.set()
+                    return
 
         t = threading.Thread(target=beat, daemon=True)
         t.start()
@@ -74,33 +100,72 @@ class Worker:
         iter_count = 0
         sleep = self.sleep
         worked = False
-        failures = 0
+        failures = 0  # CONSECUTIVE failures; reset by every success
         while iter_count < self.max_iter:
-            job_tbl, status = self.task.take_next_job(
-                self.name, Task.tmpname())
+            try:
+                job_tbl, status = self.task.take_next_job(
+                    self.name, Task.tmpname())
+            except PermissionError:
+                raise  # auth misconfig: no amount of retrying fixes it
+            except OSError as exc:
+                # board unreachable (RetryError / CircuitOpenError /
+                # reset): an idle poll, not a death sentence — back off
+                # like any idle iteration; a board that never comes back
+                # exhausts max_iter and the worker exits normally
+                logger.warning("%s: job board unreachable (%s); "
+                               "backing off", self.name, exc)
+                iter_count += 1
+                time.sleep(sleep)
+                sleep = min(sleep * 1.5, self.max_sleep)
+                continue
             if job_tbl is not None:
+                fence = threading.Event()
+                self.current_fence = fence
                 job = Job(self.cnn, job_tbl, status, self.task.tbl,
-                          self.task.jobs_ns())
+                          self.task.jobs_ns(), fence=fence)
                 logger.info("%s: running %s job %s", self.name,
                             status.value, job.get_id())
                 try:
-                    self._run_job(job)
+                    self._run_job(job, fence)
                     if status == TASK_STATUS.MAP:
                         self.task.note_written_map_job(job.get_id())
                     self.jobs_done += 1
                     worked = True
+                    # a success proves this worker is healthy: only an
+                    # unbroken run of failures should end it, or a
+                    # long-lived worker's occasional transient faults
+                    # accumulate into a lifetime death sentence
+                    failures = 0
+                except LeaseLostError:
+                    # fenced, not failed: the job was reaped/re-issued
+                    # (e.g. a partition outlasted job_lease) and its new
+                    # owner runs it now.  This worker is healthy — don't
+                    # mark BROKEN (the claim guard wouldn't match anyway),
+                    # don't count it toward giving up.
+                    logger.warning("%s: job %s fenced after lease loss",
+                                   self.name, job.get_id())
                 except Exception as exc:
                     # xpcall shield: mark BROKEN, report, maybe give up
                     # (worker.lua:112-138)
                     logger.exception("%s: job %s failed", self.name,
                                      job.get_id())
-                    job.mark_as_broken()
-                    self.cnn.insert_exception(self.name, exc)
+                    try:
+                        job.mark_as_broken()
+                        self.cnn.insert_exception(self.name, exc)
+                    except Exception:
+                        # the BROKEN mark and the errors channel ride the
+                        # same network as the board; when the job failed
+                        # BECAUSE of a partition these fail too.  Keep the
+                        # shield: the lease reaper re-issues the job either
+                        # way, a dead worker thread helps nobody.
+                        logger.exception(
+                            "%s: could not report job failure", self.name)
                     failures += 1
                     if failures >= MAX_WORKER_RETRIES:
                         logger.error(
-                            "%s: %d failures, giving up on task "
-                            "(worker.lua:133-137)", self.name, failures)
+                            "%s: %d consecutive failures, giving up on "
+                            "task (worker.lua:133-137)", self.name,
+                            failures)
                         return worked
                 iter_count = 0
                 sleep = self.sleep
@@ -122,7 +187,15 @@ class Worker:
             iter_count = 0
             sleep = self.sleep
             while iter_count < self.max_iter:
-                if self.task.update() and not self.task.finished():
+                try:
+                    has_task = self.task.update()
+                except PermissionError:
+                    raise
+                except OSError as exc:  # same shield as the claim loop
+                    logger.warning("%s: job board unreachable (%s); "
+                                   "backing off", self.name, exc)
+                    has_task = False
+                if has_task and not self.task.finished():
                     if self.task.status() != TASK_STATUS.WAIT:
                         break
                 iter_count += 1
@@ -138,13 +211,14 @@ class Worker:
 def spawn_worker_threads(connstr: str, dbname: str, n: int,
                          conf: Optional[Dict[str, Any]] = None,
                          auth: Optional[Any] = None,
+                         retry: Optional[Any] = None,
                          ) -> List[threading.Thread]:
     """Run *n* workers as daemon threads in this process — the rebuild's
     'fake cluster' for tests and the single-host deployment (the reference
     uses N OS processes under ``screen``, test.sh:10)."""
     threads = []
     for i in range(n):
-        w = Worker(connstr, dbname, auth=auth, name=f"w{i}")
+        w = Worker(connstr, dbname, auth=auth, name=f"w{i}", retry=retry)
         if conf:
             w.configure(conf)
         t = threading.Thread(target=w.execute, daemon=True,

@@ -1,0 +1,78 @@
+"""Instrumented wordcount module for the network-chaos tests
+(tests/test_chaos.py): counts map executions per job key — STARTED at
+entry, COMPLETED after the last emit — so a test can PROVE no duplicate
+execution survived a fault (lease fencing) rather than just observing a
+correct-looking result.  One key can be made to block on the HOLD event
+on its first attempt, pinning a worker inside the job while the test
+partitions its network."""
+
+import collections
+import threading
+from typing import Any, Dict, List
+
+from mapreduce_tpu.utils.hashing import fnv1a32
+
+conf: Dict[str, Any] = {"files": [], "num_reducers": 3, "hold_key": None}
+RESULT: Dict[str, int] = {}
+STARTED: "collections.Counter" = collections.Counter()
+COMPLETED: "collections.Counter" = collections.Counter()
+#: released by the test to let a held first attempt proceed
+HOLD = threading.Event()
+_lock = threading.Lock()
+
+associative_reducer = True
+commutative_reducer = True
+idempotent_reducer = True
+
+
+def reset(files, num_reducers=3, hold_key=None):
+    conf["files"] = files
+    conf["num_reducers"] = num_reducers
+    conf["hold_key"] = hold_key
+    STARTED.clear()
+    COMPLETED.clear()
+    RESULT.clear()
+    HOLD.clear()
+
+
+def init(args: Any) -> None:
+    if args:
+        conf.update(args)
+
+
+def taskfn(emit) -> None:
+    for i, path in enumerate(conf["files"]):
+        emit(i, path)
+
+
+def mapfn(key: Any, value: str, emit) -> None:
+    with _lock:
+        STARTED[key] += 1
+        attempt = STARTED[key]
+    if key == conf["hold_key"] and attempt == 1:
+        # pin this worker inside the job until the test releases it —
+        # long enough for a partition to outlast the job lease
+        HOLD.wait(timeout=30)
+    with open(value, "r") as f:
+        for line in f:
+            for word in line.split():
+                emit(word, 1)
+    # reached only if every emit went through (a fenced run dies at its
+    # first emit after the fence drops) — the duplicate-execution probe
+    with _lock:
+        COMPLETED[key] += 1
+
+
+def partitionfn(key: str) -> int:
+    return fnv1a32(key.encode()) % conf["num_reducers"]
+
+
+def reducefn(key: str, values: List[int]) -> int:
+    return sum(values)
+
+
+def finalfn(pairs) -> bool:
+    RESULT.clear()
+    for key, values in pairs:
+        RESULT[key] = values[0]
+    return True

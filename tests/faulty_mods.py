@@ -11,19 +11,27 @@ RESULT: Dict[str, int] = {}
 #: mutable knobs the tests poke
 FAIL_TIMES = {"n": 0}        # fail the first n map attempts (then succeed)
 ALWAYS_FAIL_KEY = {"key": None}  # this job key fails every time
+#: every job key fails its FIRST attempt, succeeds on retry — interleaves
+#: failures with successes, the pattern that must NOT kill a worker whose
+#: failure counter is consecutive (worker.py regression)
+FAIL_FIRST_PER_KEY = {"on": False}
 _attempts = {"count": 0}
+_key_attempts: Dict[Any, int] = {}
 
 associative_reducer = True
 commutative_reducer = True
 idempotent_reducer = True
 
 
-def reset(files, num_reducers=3, fail_times=0, always_fail_key=None):
+def reset(files, num_reducers=3, fail_times=0, always_fail_key=None,
+          fail_first_per_key=False):
     conf["files"] = files
     conf["num_reducers"] = num_reducers
     FAIL_TIMES["n"] = fail_times
     ALWAYS_FAIL_KEY["key"] = always_fail_key
+    FAIL_FIRST_PER_KEY["on"] = fail_first_per_key
     _attempts["count"] = 0
+    _key_attempts.clear()
     RESULT.clear()
 
 
@@ -40,6 +48,11 @@ def taskfn(emit) -> None:
 def mapfn(key: Any, value: str, emit) -> None:
     if ALWAYS_FAIL_KEY["key"] is not None and key == ALWAYS_FAIL_KEY["key"]:
         raise RuntimeError(f"injected permanent failure for job {key}")
+    if FAIL_FIRST_PER_KEY["on"]:
+        _key_attempts[key] = _key_attempts.get(key, 0) + 1
+        if _key_attempts[key] == 1:
+            raise RuntimeError(
+                f"injected first-attempt failure for job {key}")
     if _attempts["count"] < FAIL_TIMES["n"]:
         _attempts["count"] += 1
         raise RuntimeError(

@@ -1,0 +1,302 @@
+"""Network-chaos tests: full map->reduce->final cycles under injected
+TCP faults (testing/faults.py), proving the paper's fault-tolerance
+claim against the NETWORK failure modes the user-fault suite
+(test_fault_tolerance.py) never touches — resets mid-claim, 5xx storms
+on the blob plane, and a partition that outlasts the job lease, with
+lease fencing verified by counting executions rather than eyeballing a
+correct-looking result."""
+
+import threading
+import time
+import uuid
+
+import pytest
+
+from mapreduce_tpu import spec
+from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+from mapreduce_tpu.examples import naive
+from mapreduce_tpu.server import Server
+from mapreduce_tpu.storage.httpstore import BlobServer
+from mapreduce_tpu.testing.faults import FaultProxy, FaultRule, FaultSchedule
+from mapreduce_tpu.utils.constants import STATUS, TASK_STATUS
+from mapreduce_tpu.utils.httpclient import (
+    CircuitOpenError, RetryPolicy)
+from mapreduce_tpu.worker import Worker, spawn_worker_threads
+from tests import chaos_mods
+
+M = "tests.chaos_mods"
+
+#: tight policy for chaos runs: fail fast enough that injected faults
+#: resolve inside the test budget, retry hard enough to ride them out
+CHAOS_RETRY = RetryPolicy(max_attempts=8, base_delay=0.02, max_delay=0.3,
+                          deadline=20.0, breaker_threshold=0)
+
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def fresh_modules():
+    spec.clear_caches()
+    yield
+    spec.clear_caches()
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    files = []
+    for i in range(4):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(f"alpha beta f{i} gamma alpha\n" * 5)
+        files.append(str(p))
+    return files
+
+
+def _params(corpus, storage=None, hold_key=None):
+    chaos_mods.reset(corpus, hold_key=hold_key)
+    params = {r: M for r in ("taskfn", "mapfn", "partitionfn", "reducefn",
+                             "finalfn")}
+    params["storage"] = storage or f"mem:{uuid.uuid4().hex}"
+    return params
+
+
+def _wait_until(pred, timeout=15.0, what="condition"):
+    give_up = time.monotonic() + timeout
+    while time.monotonic() < give_up:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- FaultSchedule semantics (deterministic, no sockets) -------------------
+
+
+def test_fault_schedule_after_count_window():
+    sched = FaultSchedule()
+    r = sched.reset(match=b"claim", after=2, count=2)
+    # non-matching traffic never consumes the rule
+    assert sched.pick("request", b"heartbeat") is None
+    # first two matches pass (after=2), next two fire (count=2), then done
+    fired = [sched.pick("request", b"a claim b") is not None
+             for _ in range(6)]
+    assert fired == [False, False, True, True, False, False]
+    assert r.hits == 2
+
+    w = FaultRule("http_error", for_secs=0.15)
+    assert w.consider("request", b"x")   # opens the window
+    assert w.consider("request", b"x")   # unlimited inside the window
+    time.sleep(0.2)
+    assert not w.consider("request", b"x")  # window over
+    assert w.hits == 2
+
+
+# -- (a) docserver connection resets mid-claim -----------------------------
+
+
+def test_wordcount_completes_through_claim_resets(corpus):
+    """RST a few claim RPCs mid-flight: the client re-sends under its
+    RetryPolicy with the SAME request id, the server's dedupe makes the
+    claim exactly-once, and no job executes twice."""
+    board = DocServer().start_background()
+    sched = FaultSchedule()
+    rule = sched.reset(match=b"find_and_modify", after=2, count=3)
+    proxy = FaultProxy(board.host, board.port, schedule=sched).start()
+    try:
+        params = _params(corpus)
+        # workers claim through the faulty path; the server drives direct
+        threads = spawn_worker_threads(
+            f"http://{proxy.address}", "ch1", 2, retry=CHAOS_RETRY)
+        server = Server(f"http://{board.host}:{board.port}", "ch1",
+                        retry=CHAOS_RETRY)
+        server.configure(params)
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=30)
+        assert rule.hits > 0, "no reset ever fired — scenario not exercised"
+        assert chaos_mods.RESULT == naive.wordcount(corpus)
+        assert stats["map"]["failed"] == 0
+        assert stats["reduce"]["failed"] == 0
+        # exactly-once: every map job ran to completion exactly once
+        assert dict(chaos_mods.COMPLETED) == {i: 1 for i in
+                                              range(len(corpus))}
+    finally:
+        proxy.stop()
+        board.shutdown()
+
+
+# -- (b) 5xx storm on the blob plane ---------------------------------------
+
+
+def test_wordcount_completes_through_blob_5xx_storm(corpus, tmp_path):
+    """Every blob request 503s for a window: retries with backoff ride it
+    out and the task finishes with the exact result, no FAILED jobs."""
+    blob = BlobServer(str(tmp_path / "blobs")).start_background()
+    sched = FaultSchedule()
+    storm = sched.http_error(for_secs=0.4, status=503)
+    proxy = FaultProxy(blob.host, blob.port, schedule=sched).start()
+    try:
+        connstr = f"mem://{uuid.uuid4().hex}"
+        params = _params(corpus, storage=f"http:{proxy.address}")
+        threads = spawn_worker_threads(connstr, "ch2", 2,
+                                       retry=CHAOS_RETRY)
+        server = Server(connstr, "ch2", retry=CHAOS_RETRY)
+        server.configure(params)
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=30)
+        assert storm.hits > 0, "no 503 ever served — storm not exercised"
+        assert chaos_mods.RESULT == naive.wordcount(corpus)
+        assert stats["map"]["failed"] == 0
+        assert stats["reduce"]["failed"] == 0
+    finally:
+        proxy.stop()
+        blob.shutdown()
+
+
+# -- (c) partition outlasts the job lease: fencing -------------------------
+
+
+def test_partition_outlasting_lease_fences_old_owner(corpus):
+    """A worker is partitioned from the board while inside a map job; the
+    lease expires, the server reaps it, a second worker re-runs the job.
+    When the partition heals, the first worker's heartbeat learns the
+    lease is lost and FENCES the stale run: it aborts at its next emit,
+    so the job's user fn completes exactly once (COMPLETED counter) —
+    the duplicate-execution window is closed, not just narrowed."""
+    board = DocServer().start_background()
+    proxy = FaultProxy(board.host, board.port).start()
+    direct = f"http://{board.host}:{board.port}"
+    try:
+        hold_key = 2
+        params = _params(corpus, hold_key=hold_key)
+        server = Server(direct, "ch3", job_lease=0.8, retry=CHAOS_RETRY)
+        server.configure(params)
+        server.task.create_collection(TASK_STATUS.WAIT, server.params, 1)
+        server._prepare_map()
+
+        # worker1 claims through the (healthy, for now) proxy; a tight
+        # policy so partitioned heartbeats fail in well under a period
+        w1 = Worker(f"http://{proxy.address}", "ch3", name="w-proxied",
+                    retry=RetryPolicy(max_attempts=2, base_delay=0.02,
+                                      deadline=0.4, breaker_threshold=0))
+        w1.heartbeat_period = 0.1
+        # the CLAIMING task stamps lease_expires; the short lease must be
+        # w1's or the partition would have to outlast the 30s default
+        w1.task.job_lease = 0.8
+        t1 = threading.Thread(target=w1.execute, daemon=True)
+        t1.start()
+        # ...until it is pinned inside the held job
+        _wait_until(lambda: chaos_mods.STARTED[hold_key] == 1,
+                    what="worker1 to start the held job")
+
+        proxy.partition()  # now its heartbeats go into the void
+
+        # a second, un-partitioned worker; the server's poll loop reaps
+        # the expired lease and worker2 re-runs the job (attempt 2 does
+        # not block on HOLD)
+        t2 = threading.Thread(
+            target=Worker(direct, "ch3", name="w-direct",
+                          retry=CHAOS_RETRY).execute, daemon=True)
+        t2.start()
+        server._poll_phase(server.task.map_jobs_ns(), "map")
+
+        proxy.heal()
+        # worker1's next heartbeat now gets an answer: claim gone -> fence
+        _wait_until(lambda: (w1.current_fence is not None
+                             and w1.current_fence.is_set()),
+                    what="worker1 to be fenced")
+        chaos_mods.HOLD.set()  # release the stale run; it must abort
+
+        server._prepare_reduce()
+        server._poll_phase(server.task.red_jobs_ns(), "reduce")
+        stats = server._compute_stats()
+        server._final()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+
+        assert chaos_mods.RESULT == naive.wordcount(corpus)
+        # the fenced run never completed: started twice, finished once
+        assert chaos_mods.STARTED[hold_key] == 2
+        assert chaos_mods.COMPLETED[hold_key] == 1
+        assert all(chaos_mods.COMPLETED[k] == 1
+                   for k in range(len(corpus)))
+        assert stats["map"]["failed"] == 0
+        # the reap really happened (BROKEN -> re-claimed -> WRITTEN)
+        doc = server.cnn.connect().find(
+            server.task.map_jobs_ns(), {"_id": str(hold_key)})[0]
+        assert doc["repetitions"] >= 1
+        assert doc["status"] == int(STATUS.WRITTEN)
+        assert doc["worker"] == "w-direct"
+    finally:
+        chaos_mods.HOLD.set()
+        proxy.stop()
+        board.shutdown()
+
+
+# -- dead endpoint: circuit breaker fails fast -----------------------------
+
+
+def test_dead_endpoint_fails_fast_via_breaker():
+    """A blackholed endpoint costs each call its deadline budget, not the
+    60s socket timeout — and once the breaker opens, calls fail in
+    microseconds instead of queueing workers behind a dead socket."""
+    proxy = FaultProxy("127.0.0.1", 1).start()  # upstream never answers
+    proxy.partition()
+    try:
+        pol = RetryPolicy(max_attempts=1, deadline=0.3,
+                          breaker_threshold=2, breaker_cooldown=60)
+        store = HttpDocStore(proxy.address, retry=pol)
+        for _ in range(2):  # transport failures accumulate to threshold
+            t0 = time.monotonic()
+            with pytest.raises(OSError):
+                store.ping()
+            assert time.monotonic() - t0 < 5.0  # deadline, not 60s
+        t0 = time.monotonic()
+        with pytest.raises(CircuitOpenError):
+            store.ping()
+        assert time.monotonic() - t0 < 0.05  # fail-FAST
+    finally:
+        proxy.stop()
+
+
+# -- long soak: everything at once (excluded from tier-1) ------------------
+
+
+@pytest.mark.slow
+def test_soak_combined_faults(tmp_path):
+    """Bigger corpus, resets + latency + a mid-run partition blip, full
+    loop to completion.  Marked slow: chaos tier-1 coverage is the
+    deterministic scenarios above."""
+    files = []
+    for i in range(12):
+        p = tmp_path / f"s{i}.txt"
+        p.write_text(f"soak words w{i % 5} alpha beta\n" * 50)
+        files.append(str(p))
+    board = DocServer().start_background()
+    sched = FaultSchedule()
+    sched.reset(match=b"find_and_modify", after=1, count=4)
+    sched.delay(0.05, count=40)
+    proxy = FaultProxy(board.host, board.port, schedule=sched).start()
+    try:
+        params = _params(files)
+        threads = spawn_worker_threads(
+            f"http://{proxy.address}", "soak", 3, retry=CHAOS_RETRY)
+        server = Server(f"http://{board.host}:{board.port}", "soak",
+                        job_lease=5.0, retry=CHAOS_RETRY)
+        server.configure(params)
+
+        def blip():
+            time.sleep(0.5)
+            proxy.partition(duration=0.5)
+
+        threading.Thread(target=blip, daemon=True).start()
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=60)
+        assert chaos_mods.RESULT == naive.wordcount(files)
+        assert stats["map"]["failed"] == 0
+        assert stats["reduce"]["failed"] == 0
+    finally:
+        proxy.stop()
+        board.shutdown()

@@ -87,6 +87,57 @@ def test_concurrent_retry_waits_for_inflight_original():
         srv.shutdown()
 
 
+def test_eviction_straggler_fails_loudly_not_double_applied(srv):
+    """Drive more than _DEDUPE_CAP mutating RPCs, then replay the very
+    first rid: its recorded answer is long evicted, so the server must
+    refuse (DedupeEvictedError) — NOT silently re-apply the $inc.  A rid
+    still inside the cap keeps the normal replay contract."""
+    import http.client as hc
+    import json as j
+
+    from mapreduce_tpu.coord.docserver import _DEDUPE_CAP
+
+    cnn = hc.HTTPConnection(srv.host, srv.port, timeout=30)
+
+    def rpc(payload):
+        cnn.request("POST", "/rpc", body=j.dumps(payload).encode())
+        r = cnn.getresponse()
+        return j.loads(r.read())
+
+    srv.store.insert("c", {"_id": "a", "n": 0})
+    first = {"op": "update", "coll": "c", "query": {"_id": "a"},
+             "update": {"$inc": {"n": 1}}, "rid": "sess:1"}
+    assert rpc(first)["result"] == 1
+    # flood the cache past its cap with other mutations from the session
+    for i in range(2, _DEDUPE_CAP + 10):
+        assert rpc({"op": "update", "coll": "c", "query": {"_id": "a"},
+                    "update": {"$set": {"x": i}},
+                    "rid": f"sess:{i}"})["ok"]
+    # a straggling retry of the evicted first rid: loud refusal...
+    reply = rpc(first)
+    assert reply["ok"] is False
+    assert reply["type"] == "DedupeEvictedError"
+    # ...and crucially NOT a silent second $inc
+    assert srv.store.find_one("c", {"_id": "a"})["n"] == 1
+    # a rid still inside the cap replays normally (recorded answer back)
+    last = _DEDUPE_CAP + 9
+    replayed = rpc({"op": "update", "coll": "c", "query": {"_id": "a"},
+                    "update": {"$set": {"x": last}},
+                    "rid": f"sess:{last}"})
+    assert replayed["ok"]
+    cnn.close()
+
+
+def test_legacy_opaque_rids_keep_old_semantics(srv):
+    """Pre-SESSION:SEQ clients (opaque uuid rids) can't be watermarked;
+    they keep the within-cap replay contract and are never refused."""
+    ins = {"op": "insert", "coll": "c2", "doc": {"_id": "z"},
+           "rid": "deadbeef"}  # no colon: legacy form
+    assert _post(srv, ins)["ok"]
+    assert _post(srv, ins)["ok"]  # replayed
+    assert srv.store.count("c2") == 1
+
+
 def test_reads_are_not_deduped(srv):
     srv.store.insert("c", {"_id": "a"})
     find = {"op": "find", "coll": "c", "rid": "rid-find"}

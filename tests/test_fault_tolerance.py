@@ -111,6 +111,42 @@ def test_dead_worker_lease_reaped_end_to_end(corpus):
     assert docs[0]["status"] == int(STATUS.WRITTEN)
 
 
+def test_interleaved_transient_failures_dont_kill_worker(corpus):
+    """Regression: the worker's give-up counter must track CONSECUTIVE
+    failures, not lifetime ones.  Every one of the 4 map jobs fails its
+    first attempt and succeeds on retry — 4 lifetime failures but never
+    more than 1 in a row.  A lifetime counter hits MAX_WORKER_RETRIES=3
+    and the single worker abandons the task mid-phase; the consecutive
+    counter never trips and the task completes exactly."""
+    import threading
+
+    faulty_mods.reset(corpus, fail_first_per_key=True)
+    connstr = f"mem://{uuid.uuid4().hex}"
+    params = _params(corpus)
+    server = Server(connstr, "ft7")
+    server.configure(params)
+    threads = spawn_worker_threads(connstr, "ft7", 1,
+                                   conf={"max_iter": 200})
+    stats = {}
+    done = threading.Event()
+
+    def drive():
+        stats.update(server.loop())
+        done.set()
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    # bounded wait so a reintroduced lifetime counter fails loudly here
+    # instead of hanging the suite on the server's poll loop
+    assert done.wait(timeout=60), (
+        "task did not finish: worker likely gave up on interleaved "
+        "transient failures (lifetime-failure counting regression)")
+    for th in threads:
+        th.join(timeout=30)
+    assert faulty_mods.RESULT == naive.wordcount(corpus)
+    assert stats["map"]["failed"] == 0
+
+
 def test_server_crash_resume_at_reduce(corpus):
     """Kill the server after map completed and reduce was planned; a new
     server must resume at REDUCE (skip map) and finish correctly

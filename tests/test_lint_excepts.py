@@ -1,0 +1,52 @@
+"""Tree-wide lint: no silent broad exception swallows in the package.
+
+``except Exception: pass`` (or a bare/except-BaseException pass) hides
+exactly the failures this codebase is built to surface — a fault-tolerant
+system that eats its own faults is untestable.  Narrow swallows
+(``except FileNotFoundError: pass``) stay legal; a broad handler must at
+least log.  AST-based so comments/strings can't fool it and formatting
+can't evade it."""
+
+import ast
+import os
+
+import mapreduce_tpu
+
+PKG_ROOT = os.path.dirname(mapreduce_tpu.__file__)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _only_pass(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(stmt, ast.Pass) for stmt in handler.body)
+
+
+def test_no_silent_broad_excepts_in_package():
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r") as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.ExceptHandler)
+                        and _is_broad(node) and _only_pass(node)):
+                    rel = os.path.relpath(path, os.path.dirname(PKG_ROOT))
+                    offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "silent broad exception swallows (except Exception/bare: pass) — "
+        "log or narrow them: " + ", ".join(offenders))
