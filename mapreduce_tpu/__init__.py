@@ -45,6 +45,7 @@ _LAZY = {
     "parallel": ".parallel",
     "native": ".native",
     "cli": ".cli",
+    "obs": ".obs",
 }
 
 #: name parity aliases: reference `tuple` module == interning,

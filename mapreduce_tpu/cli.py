@@ -11,6 +11,8 @@ strips ``.lua``):
       [--max-sleep S] [--max-tasks N]
   python -m mapreduce_tpu.cli wordcount FILES... [--device] — convenience
       wrapper over the WordCount example / device engine.
+  python -m mapreduce_tpu.cli status CONNSTR [--watch S] — live cluster
+      view polled from the docserver's /statusz endpoint.
 
 CONNSTR is ``mem://NAME`` (single process), ``dir:///PATH`` (shared
 directory: OS processes on one host / NFS), or ``http://HOST:PORT``
@@ -88,6 +90,21 @@ def _retry_policy(args):
     return RetryPolicy(**overrides)
 
 
+def _add_trace(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="on exit, write this process's spans as Chrome "
+                        "trace-event JSON (load in Perfetto / "
+                        "chrome://tracing)")
+
+
+def _export_trace(args) -> None:
+    if getattr(args, "trace_out", None):
+        from .obs.trace import TRACER
+
+        print(f"trace written to {TRACER.export(args.trace_out)}",
+              file=sys.stderr)
+
+
 def _setup_logging(verbose: int) -> None:
     level = (logging.WARNING, logging.INFO, logging.DEBUG)[min(verbose, 2)]
     logging.basicConfig(
@@ -112,6 +129,7 @@ def cmd_server(argv: List[str]) -> int:
     p.add_argument("--result-ns", default=None)
     _add_auth(p)
     _add_retry(p)
+    _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -139,6 +157,7 @@ def cmd_server(argv: List[str]) -> int:
     server.configure(params)
     stats = server.loop()
     print(json.dumps(stats, default=float))
+    _export_trace(args)
     return 0
 
 
@@ -153,6 +172,7 @@ def cmd_worker(argv: List[str]) -> int:
     p.add_argument("--max-tasks", type=int, default=None)
     _add_auth(p)
     _add_retry(p)
+    _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -174,6 +194,7 @@ def cmd_worker(argv: List[str]) -> int:
                                        auth=args.auth, retry=retry)
         for t in threads:
             t.join()
+    _export_trace(args)
     return 0
 
 
@@ -185,6 +206,7 @@ def cmd_wordcount(argv: List[str]) -> int:
                         "host job-board path")
     p.add_argument("--workers", type=int, default=4)
     p.add_argument("--num-reducers", type=int, default=15)
+    _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose)
@@ -222,6 +244,25 @@ def cmd_wordcount(argv: List[str]) -> int:
     counts = dict(RESULT)
     for word in sorted(counts, key=lambda w: (-counts[w], w)):
         print(counts[word], word)
+    # run summary straight off the metrics registry — the same numbers
+    # /metrics would serve, so the CLI report can't drift from them
+    from .obs.metrics import REGISTRY
+
+    def _written(phase):  # "all" counts WRITTEN plus FAILED terminals
+        return int(REGISTRY.sum("mrtpu_stats_jobs", phase=phase,
+                                state="all")
+                   - REGISTRY.sum("mrtpu_stats_jobs", phase=phase,
+                                  state="failed"))
+
+    print(
+        "run: {} map + {} reduce jobs written | storage {:.0f} B written, "
+        "{:.0f} B read | {:.0f} http retries".format(
+            _written("map"), _written("reduce"),
+            REGISTRY.sum("mrtpu_storage_bytes_total", direction="write"),
+            REGISTRY.sum("mrtpu_storage_bytes_total", direction="read"),
+            REGISTRY.sum("mrtpu_http_retries_total")),
+        file=sys.stderr)
+    _export_trace(args)
     if wedged:
         # a silent abandon here hides wedged shutdowns (a worker stuck in
         # a claim/IO call past the FINISHED broadcast); name the stragglers
@@ -281,7 +322,8 @@ def cmd_docserver(argv: List[str]) -> int:
     store = DirDocStore(args.root) if args.root else None
     srv = DocServer(store, args.host, args.port, auth_token=args.auth)
     print(f"job board at http://{srv.host}:{srv.port} "
-          f"(CONNSTR: \"http://HOST:{srv.port}\")", flush=True)
+          f"(CONNSTR: \"http://HOST:{srv.port}\"; Prometheus at "
+          f"/metrics, cluster snapshot at /statusz)", flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -321,6 +363,132 @@ def cmd_drop(argv: List[str]) -> int:
     return 0
 
 
+def render_status(snap: dict) -> str:
+    """One-screen text view of a /statusz snapshot (the master status
+    page role, Dean & Ghemawat §4.6)."""
+    lines: List[str] = []
+    tasks = snap.get("tasks", {})
+    if not tasks:
+        return "no tasks on this board\n"
+    for db, t in sorted(tasks.items()):
+        lines.append(f"[{db}]  status={t.get('status')}  "
+                     f"iteration={t.get('iteration')}"
+                     + ("  (device plane)" if t.get("device") else ""))
+        for phase in ("map", "reduce"):
+            counts = t.get("phases", {}).get(phase) or {}
+            total = sum(counts.values())
+            if not total:
+                lines.append(f"  {phase:<7}-")
+                continue
+            parts = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+            lines.append(f"  {phase:<7}{total} jobs: {parts}")
+        workers = t.get("workers", {})
+        if workers:
+            for name, w in sorted(workers.items()):
+                lease = w.get("lease_expires_in")
+                liveness = ("ALIVE" if w.get("alive") else
+                            "idle/done" if w.get("running", 0) == 0
+                            else "STALE")
+                lease_s = (f" lease {lease:+.1f}s" if lease is not None
+                           else "")
+                lines.append(
+                    f"  worker {name}: {liveness}  "
+                    f"{w.get('running', 0)} running / "
+                    f"{w.get('jobs', 0)} held{lease_s}")
+        else:
+            lines.append("  workers: none seen")
+        nerr = t.get("errors", 0)
+        if nerr:
+            lines.append(f"  ERRORS: {nerr} in the errors channel")
+        stats = t.get("stats")
+        if stats:
+            m, r = stats.get("map", {}), stats.get("reduce", {})
+            lines.append(
+                "  last stats: map {}j/{}f cpu {:.2f}s | reduce {}j/{}f "
+                "cpu {:.2f}s | cluster {:.2f}s (iter {})".format(
+                    m.get("count", 0), m.get("failed", 0),
+                    m.get("sum_cpu_time", 0.0),
+                    r.get("count", 0), r.get("failed", 0),
+                    r.get("sum_cpu_time", 0.0),
+                    stats.get("cluster_time", 0.0),
+                    stats.get("iteration", 0)))
+    return "\n".join(lines) + "\n"
+
+
+def cmd_status(argv: List[str]) -> int:
+    """Live cluster view: poll the docserver's /statusz and render it
+    (the reference had only the end-of-run stats doc; this is the
+    during-the-run window)."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu status")
+    p.add_argument("connstr",
+                   help="the docserver, http://HOST:PORT "
+                        "(the same CONNSTR workers use)")
+    p.add_argument("--watch", type=float, default=None, metavar="S",
+                   help="re-poll every S seconds until interrupted "
+                        "(default: render once and exit)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the raw /statusz JSON instead")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    from .coord.docserver import HttpDocStore
+
+    connstr = args.connstr
+    if connstr.startswith("http://"):
+        connstr = connstr[len("http://"):]
+    # a pasted browser URL arrives with a trailing slash or path —
+    # HOST:PORT is all the client wants
+    connstr = connstr.split("/", 1)[0]
+    try:
+        store = HttpDocStore(connstr, auth_token=args.auth)
+    except ValueError:
+        print(f"status wants a docserver address (http://HOST:PORT), "
+              f"got {args.connstr!r} — mem:// and dir:// boards live "
+              "inside their owning process and have no wire to poll",
+              file=sys.stderr)
+        return 2
+    import time as _time
+
+    try:
+        while True:
+            try:
+                snap = store.statusz()
+            except PermissionError as exc:
+                # auth rejection never heals on its own: bail out even
+                # in watch mode, with the real diagnosis
+                print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+                      file=sys.stderr)
+                return 2
+            except OSError as exc:
+                if args.watch is None:
+                    print(f"cannot reach {args.connstr}: {exc}",
+                          file=sys.stderr)
+                    return 1
+                # watch mode exists precisely for degraded clusters: a
+                # transient poll failure is a line, not an exit
+                print(f"[poll failed: {exc}]", file=sys.stderr)
+            else:
+                if args.as_json:
+                    out = json.dumps(snap, indent=2, default=float)
+                else:
+                    out = render_status(snap)
+                if args.watch is not None and not args.as_json:
+                    # one-screen refresh: clear + home, like watch(1);
+                    # --json is a stream for machines, never cleared
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                sys.stdout.write(out)
+                sys.stdout.flush()
+                if args.watch is None:
+                    return 0
+            _time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        store.close()
+
+
 def cmd_warmup(argv: List[str]) -> int:
     """Prime the persistent XLA compilation cache for the device engine
     (cold compile is ~100s at bench shapes — the lax.sort comparator;
@@ -357,7 +525,7 @@ def cmd_warmup(argv: List[str]) -> int:
 COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "wordcount": cmd_wordcount, "drop": cmd_drop,
             "blobserver": cmd_blobserver, "docserver": cmd_docserver,
-            "warmup": cmd_warmup}
+            "warmup": cmd_warmup, "status": cmd_status}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -39,12 +39,33 @@ import http.server
 import itertools
 import json
 import threading
+import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..obs import metrics as _metrics
+from ..obs.statusz import cluster_status, update_board_gauges
+from ..obs.trace import TRACE_HEADER, TRACER
 from ..utils.httpclient import (
     KeepAliveClient, RetryPolicy, check_auth, default_auth_token)
 from .docstore import Doc, DocStore, MemoryDocStore, Query
+
+_REQUESTS = _metrics.counter(
+    "mrtpu_docserver_requests_total",
+    "docserver RPCs served (labels: op, outcome=ok|error|replayed|"
+    "evicted|unauthorized|bad_request)")
+_RPC_SECONDS = _metrics.histogram(
+    "mrtpu_docserver_rpc_seconds",
+    "docserver RPC execution latency (labels: op)")
+_DEDUPE_HITS = _metrics.counter(
+    "mrtpu_docserver_dedupe_hits_total",
+    "mutating RPC retries answered from the dedupe cache")
+_DEDUPE_EVICTED = _metrics.counter(
+    "mrtpu_docserver_dedupe_evicted_total",
+    "straggler retries refused because their dedupe entry was evicted")
+_SCRAPES = _metrics.counter(
+    "mrtpu_docserver_scrapes_total",
+    "GET requests to the exposition endpoints (labels: path)")
 
 # ops whose second application would change state: answered once, replayed
 # from the dedupe cache on retry.  Reads re-execute harmlessly.
@@ -87,9 +108,10 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
 
-    def _respond(self, code: int, body: bytes) -> None:
+    def _respond(self, code: int, body: bytes,
+                 ctype: str = "application/json") -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -101,6 +123,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         if not check_auth(self.auth_token, self.headers):
             # drain the body first so the keep-alive stream stays in sync
             self.rfile.read(length)
+            _REQUESTS.inc(op="-", outcome="unauthorized")
             return self._respond(401, json.dumps(
                 {"ok": False, "type": "PermissionError",
                  "error": "auth required (bad or missing bearer token)"}
@@ -110,6 +133,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             op = req["op"]
         except (json.JSONDecodeError, KeyError, UnicodeDecodeError,
                 TypeError):  # TypeError: valid JSON that isn't an object
+            _REQUESTS.inc(op="-", outcome="bad_request")
             return self._respond(400, b"{}")
 
         rid = req.get("rid") if op in _MUTATING_OPS else None
@@ -134,12 +158,16 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                     else:
                         self.inflight[rid] = threading.Event()
             if stale:
+                _DEDUPE_EVICTED.inc()
+                _REQUESTS.inc(op=op, outcome="evicted")
                 return self._respond(200, json.dumps(
                     {"ok": False, "type": "DedupeEvictedError",
                      "error": f"rid {rid}: retry arrived after its dedupe "
                               "entry was evicted; cannot guarantee "
                               "exactly-once"}).encode())
             if replay is not None:
+                _DEDUPE_HITS.inc()
+                _REQUESTS.inc(op=op, outcome="replayed")
                 return self._respond(200, replay)
             if waiter is not None:
                 waiter.wait(timeout=60)
@@ -150,12 +178,24 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                         {"ok": False, "type": "IOError",
                          "error": "retried rpc: original did not complete"}
                     ).encode()
+                    # NOT a dedupe hit: the cache had no answer — a
+                    # wedged original must show as an error, not a replay
+                    _REQUESTS.inc(op=op, outcome="error")
+                else:
+                    _DEDUPE_HITS.inc()
+                    _REQUESTS.inc(op=op, outcome="replayed")
                 return self._respond(200, replay)
 
         body = None
+        t_exec = time.monotonic()
         try:
-            result = self._execute(op, req)
+            # adopt the caller's span (TRACE_HEADER) so this RPC's span
+            # nests under the client-side job/claim trace in Perfetto
+            with TRACER.adopt(self.headers.get(TRACE_HEADER)), \
+                    TRACER.span(f"rpc:{op}", coll=req.get("coll")):
+                result = self._execute(op, req)
             body = json.dumps({"ok": True, "result": result}).encode()
+            _REQUESTS.inc(op=op, outcome="ok")
         except Exception as exc:
             # catch EVERYTHING: a reserved rid must always get a recorded
             # response, or the client's reconnect-retry would re-execute a
@@ -163,7 +203,9 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             # mid-multi-update on a dir:// board)
             body = json.dumps({"ok": False, "type": type(exc).__name__,
                                "error": str(exc)}).encode()
+            _REQUESTS.inc(op=op, outcome="error")
         finally:
+            _RPC_SECONDS.observe(time.monotonic() - t_exec, op=op)
             if rid is not None:
                 with self.dedupe_lock:
                     ev = self.inflight.pop(rid, None)
@@ -185,6 +227,37 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                 if ev is not None:
                     ev.set()
         self._respond(200, body)
+
+    def do_GET(self) -> None:
+        """Exposition plane: ``/metrics`` (Prometheus text over the
+        process-global registry, with job-board depth gauges refreshed at
+        scrape time), ``/statusz`` (JSON cluster snapshot), ``/healthz``.
+        /metrics and /statusz are auth-gated like the RPC plane (the
+        board's contents leak through both); /healthz is open — it
+        returns a static liveness body and nothing else, and orchestrator
+        probes (k8s httpGet, load balancers) cannot send a bearer token."""
+        if self.path not in ("/metrics", "/statusz", "/healthz"):
+            return self._respond(404, b"{}")
+        if self.path == "/healthz":
+            _SCRAPES.inc(path=self.path)
+            return self._respond(200, b'{"ok": true}')
+        if not check_auth(self.auth_token, self.headers):
+            return self._respond(401, b"{}")
+        _SCRAPES.inc(path=self.path)
+        try:
+            if self.path == "/metrics":
+                update_board_gauges(self.store)
+                body = _metrics.REGISTRY.render().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = json.dumps(cluster_status(self.store)).encode()
+                ctype = "application/json"
+        except Exception as exc:
+            # a scrape must never kill the handler thread mid-chaos; the
+            # scraper sees the failure as a 500, not a hung socket
+            return self._respond(500, json.dumps(
+                {"error": f"{type(exc).__name__}: {exc}"}).encode())
+        self._respond(200, body, ctype=ctype)
 
     def _execute(self, op: str, req: Dict[str, Any]) -> Any:
         store = self.store
@@ -355,6 +428,26 @@ class HttpDocStore(DocStore):
 
     def ping(self) -> bool:
         return self._rpc("ping") == "pong"
+
+    # -- exposition plane (the status CLI's feed) --------------------------
+
+    def statusz(self) -> Dict[str, Any]:
+        """Fetch the server's /statusz cluster snapshot."""
+        status, raw = self._client.request("GET", "/statusz")
+        if status == 401:
+            raise PermissionError("statusz: auth rejected")
+        if status != 200:
+            raise IOError(f"statusz: HTTP {status}")
+        return json.loads(raw)
+
+    def metrics_text(self) -> str:
+        """Fetch the server's /metrics Prometheus exposition."""
+        status, raw = self._client.request("GET", "/metrics")
+        if status == 401:
+            raise PermissionError("metrics: auth rejected")
+        if status != 200:
+            raise IOError(f"metrics: HTTP {status}")
+        return raw.decode()
 
     def close(self) -> None:
         self._client.close()

@@ -28,6 +28,7 @@ import urllib.parse
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import spec
+from ..obs.trace import TRACER
 from ..utils.constants import (
     STATUS, TASK_STATUS, MAX_MAP_RESULT, MAP_RESULT_TEMPLATE)
 from ..utils.iterators import merge_iterator
@@ -187,7 +188,10 @@ class Job:
         the worker's --auth without env/DSL plumbing."""
         from ..utils.httpclient import push_ambient_auth, restore_ambient_auth
 
-        t_cpu, t_real = time.process_time(), time.time()
+        # durations on the monotonic clock: an NTP step mid-job must not
+        # corrupt the persisted real_time (started_time/written_time stay
+        # wall-clock by contract — they are timestamps, not durations)
+        t_cpu, t_real = time.process_time(), time.monotonic()
         prev_auth = push_ambient_auth(
             self._cnn.auth_token(),
             ambient_scope(self._cnn, self.task_tbl.get("storage")))
@@ -203,7 +207,7 @@ class Job:
             restore_ambient_auth(prev_auth)
         self._check_fence()
         owned = self.mark_as_written(time.process_time() - t_cpu,
-                                     time.time() - t_real)
+                                     time.monotonic() - t_real)
         # delete consumed map files only once WRITTEN is durable AND this
         # claim still owned the job (a reaped+reclaimed job's files belong
         # to the new owner's re-run); reference deletes pre-write,
@@ -242,31 +246,35 @@ class Job:
             if combiner is not None and len(bucket) >= MAX_MAP_RESULT:
                 result[sk] = [combiner(key, bucket)]
 
-        mapfn(self.tbl["key"], self.tbl["value"], emit)
-        self.mark_as_finished()
+        with TRACER.span("run", phase="map", job=self.get_id()):
+            mapfn(self.tbl["key"], self.tbl["value"], emit)
+            self.mark_as_finished()
 
-        # sort keys, write-time combine, partition (job.lua:194-215)
-        per_part: Dict[int, List[str]] = {}
-        for sk in sorted(result.keys()):
-            key = keyorder[sk]
-            values = result[sk]
-            if combiner is not None and len(values) > 1:
-                values = [combiner(key, values)]
-            part = partfn(key)
-            if not isinstance(part, int):
-                raise TypeError(
-                    f"partitionfn must return int, got {type(part).__name__}"
-                    " (reference job.lua:203-207)")
-            per_part.setdefault(part, []).append(
-                serialize_record(key, values))
+            # sort keys, write-time combine, partition (job.lua:194-215)
+            per_part: Dict[int, List[str]] = {}
+            for sk in sorted(result.keys()):
+                key = keyorder[sk]
+                values = result[sk]
+                if combiner is not None and len(values) > 1:
+                    values = [combiner(key, values)]
+                part = partfn(key)
+                if not isinstance(part, int):
+                    raise TypeError(
+                        f"partitionfn must return int, got "
+                        f"{type(part).__name__}"
+                        " (reference job.lua:203-207)")
+                per_part.setdefault(part, []).append(
+                    serialize_record(key, values))
 
-        ns = map_results_prefix(self.path)
-        for part, lines in per_part.items():
-            self._check_fence()
-            b = self._storage.builder()
-            for line in lines:
-                b.write_record_line(line)
-            b.build(map_file_name(ns, part, self.get_id()))
+        with TRACER.span("write", phase="map", job=self.get_id(),
+                         partitions=len(per_part)):
+            ns = map_results_prefix(self.path)
+            for part, lines in per_part.items():
+                self._check_fence()
+                b = self._storage.builder()
+                for line in lines:
+                    b.write_record_line(line)
+                b.build(map_file_name(ns, part, self.get_id()))
 
     def _execute_reduce(self) -> None:
         """job_prepare_reduce (job.lua:230-296): merge all mappers' files
@@ -283,17 +291,20 @@ class Job:
             for n in files
         ]
         b = self._storage.builder()
-        for key, values in merge_iterator(sources):
-            self._check_fence()
-            # ACI fast path: a single value needs no reduce call
-            # (job.lua:264-284)
-            if aci and len(values) == 1:
-                out = values[0]
-            else:
-                out = reducefn(key, values)
-            check_serializable(out)
-            b.write_record_line(serialize_record(key, [out]))
-        b.build(result_name)
+        with TRACER.span("run", phase="reduce", job=self.get_id(),
+                         inputs=len(files)):
+            for key, values in merge_iterator(sources):
+                self._check_fence()
+                # ACI fast path: a single value needs no reduce call
+                # (job.lua:264-284)
+                if aci and len(values) == 1:
+                    out = values[0]
+                else:
+                    out = reducefn(key, values)
+                check_serializable(out)
+                b.write_record_line(serialize_record(key, [out]))
+        with TRACER.span("write", phase="reduce", job=self.get_id()):
+            b.build(result_name)
         # deletion of consumed inputs is deferred to execute(), post-WRITTEN
         self._consumed = files
 

@@ -475,7 +475,7 @@ class DeviceEngine:
         so overlapping them just serialises both.)"""
         import time
 
-        t0 = time.time()
+        t0 = time.monotonic()
         if k is None:
             row_bytes = int(np.dtype(row_dtype).itemsize
                             * np.prod(row_shape))
@@ -503,7 +503,7 @@ class DeviceEngine:
             sharding=NamedSharding(self.mesh, P(AXIS)))
             for a in out_info[:4]]
         self._get_merge(cfg).lower(*merged).compile()
-        return time.time() - t0
+        return time.monotonic() - t0
 
     def stage_inputs(self, chunks: np.ndarray, waves: int = None):
         """Issue and COMPLETE the host->device transfer of *chunks*,
@@ -588,7 +588,7 @@ class DeviceEngine:
         import time
 
         cfg = self.config
-        t_start = time.time()
+        t_start = time.monotonic()
         feeder = None
         pairs = None  # staged, pre-resolved waves (consumed in place)
         if staged is not None:
@@ -625,14 +625,14 @@ class DeviceEngine:
             for attempt in range(max_retries + 1):
                 fn = self._get_compiled(cfg)
                 merge = self._get_merge(cfg) if W > 1 else None
-                t0 = time.time()
+                t0 = time.monotonic()
                 t_blocked = 0.0
                 acc = None
                 oflows = []
                 wave_oflows = []
                 need_arrays = []
                 for w in range(W):
-                    tb = time.time()
+                    tb = time.monotonic()
                     if pairs is not None:
                         ci, ii = pairs[w]
                     else:
@@ -641,7 +641,7 @@ class DeviceEngine:
                     # in-flight transfer (measured to throttle the
                     # tunnelled link); the wait is charged to upload
                     jax.block_until_ready(ci)
-                    t_blocked += time.time() - tb
+                    t_blocked += time.monotonic() - tb
                     if w >= depth:
                         # bound the dispatch queue via a VALUE readback:
                         # on the tunnelled platform block_until_ready on
@@ -680,7 +680,7 @@ class DeviceEngine:
                 # re-upload (inputs were freed wave by wave) and that cost
                 # must show in the stats meant to expose it
                 t_upload += t_blocked
-                t_compute += time.time() - t0 - t_blocked
+                t_compute += time.monotonic() - t0 - t_blocked
                 if total_oflow == 0 or attempt == max_retries:
                     break  # done, or out of retries (don't size a cfg
                     # that will never run)
@@ -714,14 +714,29 @@ class DeviceEngine:
                 "on_overflow='return' to inspect the truncated result")
         # sliced readback: only the live prefix of each partition's
         # capacity-padded result crosses the (slow) device->host link
-        t0 = time.time()
+        t0 = time.monotonic()
         n_live = self._host(valid.sum(axis=1))
         width = max(1, int(n_live.max()))
         keys_h, vals_h, pay_h, valid_h = self._host(
             keys[:, :width], vals[:, :width], pay[:, :width],
             valid[:, :width])
         result = DeviceResult(keys_h, vals_h, pay_h, valid_h, total_oflow)
-        t_readback = time.time() - t0
+        t_readback = time.monotonic() - t0
+        # live counters for the exposition plane regardless of whether
+        # the caller asked for a timings dict: per-wave upload/compute/
+        # readback seconds are the device-path hot-path metrics
+        from ..obs import metrics as _obs
+
+        _obs.counter("mrtpu_device_waves_total",
+                     "device-engine waves executed").inc(W)
+        _obs.counter("mrtpu_device_retries_total",
+                     "capacity-overflow recompile retries").inc(retries)
+        sec = _obs.counter(
+            "mrtpu_device_seconds_total",
+            "device-engine wall seconds by stage (labels: stage)")
+        sec.inc(t_upload, stage="upload")
+        sec.inc(t_compute, stage="compute")
+        sec.inc(t_readback, stage="readback")
         if timings is not None:
             timings["waves"] = W
             timings["retries"] = retries
@@ -744,5 +759,5 @@ class DeviceEngine:
                 # staged callers assemble their own run total (their
                 # upload happened elsewhere); an engine-local total here
                 # would contradict it
-                timings["total_s"] = round(time.time() - t_start, 3)
+                timings["total_s"] = round(time.monotonic() - t_start, 3)
         return result

@@ -181,11 +181,11 @@ class DeviceWordCount:
         (server.lua:555-600)."""
         import time
 
-        t0 = time.time()
+        t0 = time.monotonic()
         # chunk count rounds up to a mesh multiple so every device
         # participates
         chunks, L = self._to_chunks(data)
-        t_split = time.time() - t0
+        t_split = time.monotonic() - t0
         result = self._engine_for(L).run(chunks, timings=timings,
                                          waves=waves)
         out = self._finish(chunks, result, timings)
@@ -223,10 +223,10 @@ class DeviceWordCount:
         reach here: run() raises on exhausted retries by default.)"""
         import time
 
-        t0 = time.time()
+        t0 = time.monotonic()
         out = materialize_counts(chunks, result)
         if timings is not None:
-            timings["materialize_s"] = round(time.time() - t0, 3)
+            timings["materialize_s"] = round(time.monotonic() - t0, 3)
         return out
 
     def _row_len(self) -> int:

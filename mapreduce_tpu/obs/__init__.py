@@ -1,0 +1,19 @@
+"""Cluster observability plane: metrics, traces, and status exposition.
+
+* :mod:`.metrics` — thread-safe counters/gauges/histograms with labels,
+  a process-global :data:`~.metrics.REGISTRY`, Prometheus text render
+  and a parser for tests;
+* :mod:`.trace` — monotonic span tracer with cross-plane header
+  propagation and Chrome trace-event export (Perfetto-loadable);
+* :mod:`.statusz` — the /statusz JSON cluster snapshot and scrape-time
+  job-board depth gauges.
+
+Pure stdlib, imported by the hot paths (httpclient, docserver, worker,
+job, storage, engine) — keep it dependency-free and fast.
+"""
+
+from .metrics import (  # noqa: F401
+    LATENCY_BUCKETS, REGISTRY, Registry, Counter, Gauge, Histogram,
+    counter, gauge, histogram, parse_prometheus)
+from .trace import TRACE_HEADER, TRACER, Tracer  # noqa: F401
+from .statusz import cluster_status, update_board_gauges  # noqa: F401

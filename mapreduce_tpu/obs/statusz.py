@@ -1,0 +1,127 @@
+"""/statusz: a JSON cluster snapshot built from the job board.
+
+The live counterpart of Dean & Ghemawat's master status page: per-phase
+job counts, worker liveness derived from heartbeat lease ages, the
+iteration counter, and the last persisted stats doc — everything an
+operator (or the ``status`` CLI) needs to see a run at a glance,
+computed fresh from the authoritative DocStore at scrape time.
+
+Wall-clock use here is TIMESTAMP comparison (``lease_expires`` fields
+are wall-clock by contract, coord/docstore.now), not duration
+arithmetic; the AST lint allowlists this module for that reason.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.constants import STATUS
+from .metrics import Registry, REGISTRY
+
+#: job-board collection suffixes that make up one task's database
+_BOARD_SUFFIXES = ("task", "map_jobs", "red_jobs", "errors")
+
+
+def _status_name(code: Any) -> str:
+    try:
+        return STATUS(int(code)).name
+    except (ValueError, TypeError):
+        return str(code)
+
+
+def _dbnames(store) -> Dict[str, Dict[str, str]]:
+    """Group board collections by database prefix: ``{db: {suffix: coll}}``
+    (collections are named ``<db>.<suffix>``, coord/connection.ns)."""
+    dbs: Dict[str, Dict[str, str]] = {}
+    for coll in store.collections():
+        db, sep, suffix = coll.rpartition(".")
+        if sep and suffix in _BOARD_SUFFIXES:
+            dbs.setdefault(db, {})[suffix] = coll
+    return dbs
+
+
+def _phase_counts(store, coll: Optional[str]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    if coll is None:
+        return counts
+    for doc in store.find(coll):
+        name = _status_name(doc.get("status"))
+        counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def _workers(store, colls, now: float) -> Dict[str, Dict[str, Any]]:
+    """Worker liveness from heartbeat-maintained leases: a worker whose
+    freshest lease is still in the future is alive (its heartbeat thread
+    extended it within the last period)."""
+    workers: Dict[str, Dict[str, Any]] = {}
+    for coll in colls:
+        if coll is None:
+            continue
+        for doc in store.find(coll):
+            name = doc.get("worker")
+            lease = doc.get("lease_expires")
+            if not name or name == "server" or lease is None:
+                continue
+            w = workers.setdefault(
+                name, {"jobs": 0, "running": 0, "lease_expires_in": None})
+            w["jobs"] += 1
+            if doc.get("status") in (int(STATUS.RUNNING),
+                                     int(STATUS.FINISHED)):
+                w["running"] += 1
+                remain = round(lease - now, 3)
+                prev = w["lease_expires_in"]
+                if prev is None or remain > prev:
+                    w["lease_expires_in"] = remain
+    for w in workers.values():
+        w["alive"] = (w["lease_expires_in"] is not None
+                      and w["lease_expires_in"] > 0)
+    return workers
+
+
+def cluster_status(store, now: Optional[float] = None) -> Dict[str, Any]:
+    """The /statusz document: one entry per task database on the board."""
+    now = time.time() if now is None else now
+    out: Dict[str, Any] = {"now": now, "tasks": {}}
+    for db, colls in sorted(_dbnames(store).items()):
+        task_doc = None
+        if "task" in colls:
+            found = store.find(colls["task"], {"_id": "unique"})
+            task_doc = found[0] if found else None
+        entry: Dict[str, Any] = {
+            "status": (task_doc or {}).get("status"),
+            "iteration": (task_doc or {}).get("iteration"),
+            "device": (task_doc or {}).get("device"),
+            "stats": (task_doc or {}).get("stats"),
+            "phases": {
+                "map": _phase_counts(store, colls.get("map_jobs")),
+                "reduce": _phase_counts(store, colls.get("red_jobs")),
+            },
+            "workers": _workers(
+                store, [colls.get("map_jobs"), colls.get("red_jobs")], now),
+            "errors": (store.count(colls["errors"])
+                       if "errors" in colls else 0),
+        }
+        out["tasks"][db] = entry
+    return out
+
+
+def update_board_gauges(store, registry: Registry = REGISTRY) -> None:
+    """Refresh ``mrtpu_board_jobs`` from the board — called by the
+    docserver right before rendering /metrics so queue depth by
+    phase/status is scrape-time truth, not a stale event count."""
+    g = registry.gauge(
+        "mrtpu_board_jobs",
+        "job-board queue depth (labels: db, phase, status)")
+    # build the whole snapshot first, then swap atomically: a concurrent
+    # scrape must never render a cleared-but-not-yet-repopulated family,
+    # and stale series from drained boards must not linger as lies
+    fresh = []
+    for db, colls in _dbnames(store).items():
+        for phase, suffix in (("map", "map_jobs"), ("reduce", "red_jobs")):
+            for status, n in _phase_counts(
+                    store, colls.get(suffix)).items():
+                fresh.append(
+                    ({"db": db, "phase": phase, "status": status}, n))
+    g.replace(fresh)

@@ -1,0 +1,183 @@
+"""Span tracer: monotonic-clock spans exported as Chrome trace events.
+
+Covers the full job lifecycle — claim -> map/reduce run -> emit -> write
+-> finalize — with a per-thread span stack so nesting falls out of
+lexical scope, and a ``TRACE_HEADER`` carrying ``trace_id:span_id``
+across BOTH HTTP planes (the blob client and the docstore client inject
+it; the docserver adopts it around each RPC), so one job's board RPCs
+and blob transfers share its trace.
+
+Clocks are ``time.monotonic()`` throughout: span durations survive an
+NTP step (the wall-clock hazard the satellite fix purges from the stats
+path).  Export is the Chrome trace-event JSON array format — complete
+("ph": "X") events with microsecond ``ts``/``dur`` on real thread ids —
+loadable directly in Perfetto / chrome://tracing.
+
+The buffer is bounded (:attr:`Tracer.max_events`); overflow drops the
+newest spans and counts them in ``mrtpu_trace_dropped_total`` rather
+than growing without bound inside a long-lived worker.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .metrics import counter
+
+#: HTTP header propagating ``trace_id:span_id`` across both planes.
+TRACE_HEADER = "X-Mrtpu-Trace"
+
+_DROPPED = counter("mrtpu_trace_dropped_total",
+                   "spans dropped because the trace buffer was full")
+_SPANS = counter("mrtpu_trace_spans_total",
+                 "spans recorded (labels: name)")
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """A live span; ``args`` may be mutated until the span closes (e.g.
+    to stamp an ``outcome``)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0", "args")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], t0: float,
+                 args: Dict[str, Any]) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.args = args
+
+
+class Tracer:
+    def __init__(self, max_events: int = 100_000) -> None:
+        self.max_events = max_events
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._tls = threading.local()
+
+    # -- span stack -------------------------------------------------------
+
+    def _stack(self) -> List[Tuple[str, Optional[str]]]:
+        """Per-thread stack of ``(trace_id, span_id)`` parents; a remote
+        parent adopted from TRACE_HEADER is just another frame."""
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current(self) -> Optional[Tuple[str, Optional[str]]]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def trace_context(self) -> Optional[str]:
+        """``trace_id:span_id`` for TRACE_HEADER, or None outside any
+        span (clients then send no header)."""
+        cur = self.current()
+        if cur is None or cur[1] is None:
+            return None
+        return f"{cur[0]}:{cur[1]}"
+
+    @contextlib.contextmanager
+    def adopt(self, header_value: Optional[str]) -> Iterator[None]:
+        """Server side: parent subsequent spans on this thread under the
+        remote caller's context (no-op for a missing/bad header)."""
+        parts = (header_value or "").split(":")
+        if len(parts) != 2 or not all(parts):
+            yield
+            return
+        st = self._stack()
+        st.append((parts[0], parts[1]))
+        try:
+            yield
+        finally:
+            st.pop()
+
+    # -- recording --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, start: Optional[float] = None,
+             **args: Any) -> Iterator[Span]:
+        """Record a complete span around the ``with`` body.
+
+        ``start`` (a ``time.monotonic()`` stamp) backdates the span — the
+        worker uses it so the per-job root span covers the claim RPC that
+        *preceded* knowing there was a job at all.
+        """
+        parent = self.current()
+        trace_id = parent[0] if parent else _new_id()
+        sp = Span(name, trace_id, _new_id(),
+                  parent[1] if parent else None,
+                  start if start is not None else time.monotonic(),
+                  dict(args))
+        st = self._stack()
+        st.append((sp.trace_id, sp.span_id))
+        try:
+            yield sp
+        finally:
+            st.pop()
+            self._record(sp, time.monotonic())
+
+    def record(self, name: str, t0: float, t1: float, **args: Any) -> None:
+        """Record an already-elapsed interval as a child of the current
+        span (the worker's retroactive ``claim`` span)."""
+        parent = self.current()
+        sp = Span(name, parent[0] if parent else _new_id(), _new_id(),
+                  parent[1] if parent else None, t0, dict(args))
+        self._record(sp, t1)
+
+    def _record(self, sp: Span, t1: float) -> None:
+        event = {
+            "name": sp.name,
+            "ph": "X",
+            "ts": round(sp.t0 * 1e6, 1),
+            "dur": max(round((t1 - sp.t0) * 1e6, 1), 0.0),
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % (1 << 31),
+            "cat": "mapreduce_tpu",
+            "args": {"trace_id": sp.trace_id, "span_id": sp.span_id,
+                     "parent_id": sp.parent_id, **sp.args},
+        }
+        _SPANS.inc(name=sp.name)
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                _DROPPED.inc()
+                return
+            self._events.append(event)
+
+    # -- export -----------------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The Chrome trace-event JSON object format (Perfetto-loadable)."""
+        return {"traceEvents": self.events(),
+                "displayTimeUnit": "ms",
+                "otherData": {"clock": "monotonic"}}
+
+    def export(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+#: the process-global tracer (the registry's sibling); instruments write
+#: here, ``--trace-out`` and the failure-artifact fixture export it.
+TRACER = Tracer()

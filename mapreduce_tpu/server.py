@@ -24,6 +24,8 @@ from .coord import docstore
 from .coord.connection import Connection
 from .coord.job import map_results_prefix
 from .coord.task import Task, make_job
+from .obs import metrics as _metrics
+from .obs.metrics import REGISTRY
 from .utils.constants import (
     STATUS, TASK_STATUS, DEFAULT_SLEEP, MAX_JOB_RETRIES,
     MAX_TASKFN_VALUE_SIZE)
@@ -34,6 +36,56 @@ from .utils.iterators import merge_iterator
 logger = logging.getLogger("mapreduce_tpu.server")
 
 TERMINAL = [int(STATUS.WRITTEN), int(STATUS.FAILED)]
+
+# -- stats gauges: the ONE source both the persisted stats doc and the
+#    /metrics exposition read, so they cannot drift apart.  Every series
+#    carries the task's db label: two Server instances in one process
+#    (the library supports several tasks per board) must not overwrite
+#    each other's stats between publish and read-back -----------------------
+_STATS_JOBS = _metrics.gauge(
+    "mrtpu_stats_jobs",
+    "terminal jobs in the last computed stats (labels: db, phase, "
+    "state=all|failed)")
+_STATS_SECONDS = _metrics.gauge(
+    "mrtpu_stats_seconds",
+    "per-phase timing sums from the last computed stats (labels: db, "
+    "phase, field=cpu|real|cluster)")
+_STATS_ITERATION = _metrics.gauge(
+    "mrtpu_stats_iteration",
+    "iteration the last stats doc covers (labels: db)")
+_STATS_DEVICE = _metrics.gauge(
+    "mrtpu_stats_device",
+    "device-phase engine timings from the last run (labels: db, field)")
+_PHASE_SECONDS = _metrics.histogram(
+    "mrtpu_server_phase_seconds",
+    "wall seconds the server spent driving one phase (labels: phase)")
+
+
+def _publish_phase_stats(db: str, phase: str, d: Dict[str, Any]) -> None:
+    _STATS_JOBS.set(d["count"], db=db, phase=phase, state="all")
+    _STATS_JOBS.set(d["failed"], db=db, phase=phase, state="failed")
+    _STATS_SECONDS.set(d["sum_cpu_time"], db=db, phase=phase, field="cpu")
+    _STATS_SECONDS.set(d["sum_real_time"], db=db, phase=phase,
+                       field="real")
+    _STATS_SECONDS.set(d["cluster_time"], db=db, phase=phase,
+                       field="cluster")
+
+
+def _phase_stats_from_registry(db: str, phase: str) -> Dict[str, Any]:
+    """Read one phase's stats BACK from the registry — the persisted doc
+    is built from these reads, so doc and /metrics agree by construction."""
+    return {
+        "count": int(REGISTRY.value("mrtpu_stats_jobs", db=db,
+                                    phase=phase, state="all")),
+        "failed": int(REGISTRY.value("mrtpu_stats_jobs", db=db,
+                                     phase=phase, state="failed")),
+        "sum_cpu_time": REGISTRY.value("mrtpu_stats_seconds", db=db,
+                                       phase=phase, field="cpu"),
+        "sum_real_time": REGISTRY.value("mrtpu_stats_seconds", db=db,
+                                        phase=phase, field="real"),
+        "cluster_time": REGISTRY.value("mrtpu_stats_seconds", db=db,
+                                       phase=phase, field="cluster"),
+    }
 
 
 class Server:
@@ -231,7 +283,9 @@ class Server:
         spec.load_role(self.params["mapfn"], "mapfn").ensure_init(
             self.params.get("init_args"))
         mesh = self._device_mesh()
-        t_cpu, t_real = time.process_time(), time.time()
+        # monotonic for the duration fields; wall clock (docstore.now)
+        # only for the started_time/written_time timestamps
+        t_cpu, t_real = time.process_time(), time.monotonic()
         chunks = ds.prepare(pairs, mesh)
         engine = self._get_device_engine(ds, mesh)
         timings: Dict[str, Any] = {}
@@ -267,7 +321,7 @@ class Server:
             {"$set": {"status": int(STATUS.WRITTEN),
                       "written_time": docstore.now(),
                       "cpu_time": time.process_time() - t_cpu,
-                      "real_time": time.time() - t_real,
+                      "real_time": time.monotonic() - t_real,
                       "device_timings": timings}})
         self._last_device_timings = timings
         logger.info("device phase: %d splits -> %d uniques, timings %s",
@@ -276,6 +330,16 @@ class Server:
     # -- statistics (server.lua:155-183, 538-600) --------------------------
 
     def _phase_stats(self, coll: str) -> Dict[str, Any]:
+        """Aggregate one phase's terminal job docs.
+
+        Clock caveat: ``cpu_time``/``real_time`` are per-job durations
+        measured on each worker's own monotonic clock (NTP-safe), but
+        ``cluster_time`` spans DIFFERENT workers — it subtracts one
+        worker's wall-clock ``started_time`` from another's
+        ``written_time`` (both stamped via docstore.now), so clock skew
+        between hosts leaks into it.  That is inherent to a cross-host
+        makespan; treat cluster_time as approximate at skew scale.
+        """
         docs = self.cnn.connect().find(coll,
                                        {"status": {"$in": TERMINAL}})
         cpu = sum(d.get("cpu_time", 0.0) for d in docs)
@@ -293,15 +357,37 @@ class Server:
         }
 
     def _compute_stats(self) -> Dict[str, Any]:
-        m = self._phase_stats(self.task.map_jobs_ns())
-        r = self._phase_stats(self.task.red_jobs_ns())
+        """Aggregate job docs -> registry gauges -> persisted stats doc.
+
+        The registry sits in the middle on purpose: the doc is built by
+        READING the gauges back (_phase_stats_from_registry), so the
+        /metrics exposition and the stats doc the reference persisted
+        (server.lua:555-600) are the same numbers by construction.
+        """
+        db = self.cnn.dbname
+        _publish_phase_stats(db, "map",
+                             self._phase_stats(self.task.map_jobs_ns()))
+        _publish_phase_stats(db, "reduce",
+                             self._phase_stats(self.task.red_jobs_ns()))
+        _STATS_ITERATION.set(self.task.iteration(), db=db)
+        m = _phase_stats_from_registry(db, "map")
+        r = _phase_stats_from_registry(db, "reduce")
+        _STATS_SECONDS.set(m["cluster_time"] + r["cluster_time"],
+                           db=db, phase="total", field="cluster")
         stats = {"map": m, "reduce": r,
-                 "cluster_time": m["cluster_time"] + r["cluster_time"],
-                 "iteration": self.task.iteration()}
+                 "cluster_time": REGISTRY.value(
+                     "mrtpu_stats_seconds", db=db, phase="total",
+                     field="cluster"),
+                 "iteration": int(REGISTRY.value("mrtpu_stats_iteration",
+                                                 db=db))}
         if self._last_device_timings is not None:
             # per-stage device timings (upload/compute/readback/waves)
             # into the persisted stats doc — the device-path form of the
-            # reference's per-phase report (server.lua:555-600)
+            # reference's per-phase report (server.lua:555-600) — and
+            # into gauges for the live exposition
+            for field, v in self._last_device_timings.items():
+                if isinstance(v, (int, float)):
+                    _STATS_DEVICE.set(v, db=db, field=field)
             stats["device"] = dict(self._last_device_timings)
         self.task.set_fields({"stats": stats})
         logger.info(
@@ -431,25 +517,30 @@ class Server:
                 it += 1
                 self.task.create_collection(TASK_STATUS.WAIT, self.params,
                                             it)
-                t0 = time.time()
+                t0 = time.monotonic()
                 self._run_device_phase()
-                logger.info("device map+reduce done in %.3fs",
-                            time.time() - t0)
+                dt = time.monotonic() - t0
+                _PHASE_SECONDS.observe(dt, phase="device")
+                logger.info("device map+reduce done in %.3fs", dt)
             else:
                 if not skip_map:
                     it += 1
                     self.task.create_collection(TASK_STATUS.WAIT,
                                                 self.params, it)
-                    t0 = time.time()
+                    t0 = time.monotonic()
                     self._prepare_map()
                     self._poll_phase(self.task.map_jobs_ns(), "map")
-                    logger.info("map done in %.3fs", time.time() - t0)
+                    dt = time.monotonic() - t0
+                    _PHASE_SECONDS.observe(dt, phase="map")
+                    logger.info("map done in %.3fs", dt)
                 else:
                     skip_map = False
-                t0 = time.time()
+                t0 = time.monotonic()
                 self._prepare_reduce()
                 self._poll_phase(self.task.red_jobs_ns(), "reduce")
-                logger.info("reduce done in %.3fs", time.time() - t0)
+                dt = time.monotonic() - t0
+                _PHASE_SECONDS.observe(dt, phase="reduce")
+                logger.info("reduce done in %.3fs", dt)
             stats = self._compute_stats()
             self._final()
         return stats

@@ -12,6 +12,15 @@ from __future__ import annotations
 import re
 from typing import Iterator, List, Optional
 
+from ..obs.metrics import storage_io, storage_op
+
+
+def _text_bytes(text: str) -> int:
+    """UTF-8 byte length of *text* — what the disk/wire backends actually
+    move.  The isascii fast path (C-speed scan) skips the encode for the
+    common all-ASCII record case."""
+    return len(text) if text.isascii() else len(text.encode("utf-8"))
+
 
 class FileBuilder:
     """Write-staging handle; nothing is visible until :meth:`build`.
@@ -24,6 +33,7 @@ class FileBuilder:
     def __init__(self, storage: "Storage") -> None:
         self._storage = storage
         self._parts: List[str] = []
+        self._records = 0
 
     def append(self, text: str) -> None:
         self._parts.append(text)
@@ -31,11 +41,17 @@ class FileBuilder:
     def write_record_line(self, line: str) -> None:
         self.append(line)
         self.append("\n")
+        self._records += 1
 
     def build(self, name: str) -> None:
         """Publish the staged content as *name*, atomically."""
-        self._storage._publish(name, "".join(self._parts))
+        content = "".join(self._parts)
+        self._storage._publish(name, content)
+        storage_io(self._storage.scheme, "write", _text_bytes(content),
+                   records=self._records)
+        storage_op(self._storage.scheme, "publish")
         self._parts = []
+        self._records = 0
 
 
 class Storage:
@@ -50,11 +66,35 @@ class Storage:
     def _publish(self, name: str, content: str) -> None:
         raise NotImplementedError
 
+    # read paths are instrumented HERE (bytes/records per plane,
+    # mrtpu_storage_*_total{scheme=...}) so each backend only implements
+    # the raw `_read` / `_open_lines`; writes are counted by
+    # FileBuilder.build, the one publish point every backend shares.
+
     def open_lines(self, name: str) -> Iterator[str]:
         """Iterate the text lines of blob *name* (newline-stripped)."""
-        raise NotImplementedError
+        records = nbytes = 0
+        try:
+            for line in self._open_lines(name):
+                records += 1
+                # +1 for the newline; blank lines the backends skip are
+                # not counted, so this is record payload, not file size
+                nbytes += _text_bytes(line) + 1
+                yield line
+        finally:
+            storage_io(self.scheme, "read", nbytes, records=records)
+            storage_op(self.scheme, "open_lines")
 
     def read(self, name: str) -> str:
+        content = self._read(name)
+        storage_io(self.scheme, "read", _text_bytes(content))
+        storage_op(self.scheme, "read")
+        return content
+
+    def _open_lines(self, name: str) -> Iterator[str]:
+        raise NotImplementedError
+
+    def _read(self, name: str) -> str:
         raise NotImplementedError
 
     def write(self, name: str, content: str) -> None:

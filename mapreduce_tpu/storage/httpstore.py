@@ -198,7 +198,7 @@ class HttpStorage(Storage):
         if status != 201:
             raise IOError(f"blob PUT {name!r} failed: HTTP {status}")
 
-    def read(self, name: str) -> str:
+    def _read(self, name: str) -> str:
         status, body = self._request("GET", self._blob_path(name))
         if status != 200:
             raise FileNotFoundError(f"{name!r}: HTTP {status}")
@@ -210,7 +210,7 @@ class HttpStorage(Storage):
     #: (utils.lua:133-200).
     LINES_CHUNK = 1 << 20
 
-    def open_lines(self, name: str) -> Iterator[str]:
+    def _open_lines(self, name: str) -> Iterator[str]:
         chunk_size = self.LINES_CHUNK
         offset = 0
         buf = b""

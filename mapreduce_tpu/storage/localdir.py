@@ -44,14 +44,14 @@ class LocalDirStorage(Storage):
             f.write(content)
         os.rename(tmp, self._fname(name))  # same fs: atomic
 
-    def open_lines(self, name: str) -> Iterator[str]:
+    def _open_lines(self, name: str) -> Iterator[str]:
         with open(self._fname(name), "r", encoding="utf-8") as f:
             for line in f:
                 line = line.rstrip("\n")
                 if line:
                     yield line
 
-    def read(self, name: str) -> str:
+    def _read(self, name: str) -> str:
         with open(self._fname(name), "r", encoding="utf-8") as f:
             return f.read()
 

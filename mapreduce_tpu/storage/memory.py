@@ -38,14 +38,14 @@ class MemoryStorage(Storage):
         with self._lock:
             self._blobs[name] = content
 
-    def open_lines(self, name: str) -> Iterator[str]:
+    def _open_lines(self, name: str) -> Iterator[str]:
         with self._lock:
             content = self._blobs[name]
         for line in content.splitlines():
             if line:
                 yield line
 
-    def read(self, name: str) -> str:
+    def _read(self, name: str) -> str:
         with self._lock:
             return self._blobs[name]
 

@@ -264,6 +264,17 @@ class FaultProxy:
         with self._clock:
             self._conns.append(s)
 
+    def _untrack(self, *socks: socket.socket) -> None:
+        """Drop finished sockets from the kill list — a reset-heavy soak
+        reconnects thousands of times and must not accumulate dead
+        socket objects (or make heal() close long-finished ones)."""
+        with self._clock:
+            for s in socks:
+                try:
+                    self._conns.remove(s)
+                except ValueError:
+                    pass  # already swept by _close_all
+
     def _close_all(self) -> None:
         with self._clock:
             conns, self._conns = self._conns, []
@@ -284,11 +295,13 @@ class FaultProxy:
     def _handle(self, client: socket.socket) -> None:
         if self.partitioned():
             self._park(client)
+            self._untrack(client)
             return
         try:
             server = socket.create_connection(self.upstream, timeout=10)
         except OSError:
             _quiet_close(client)
+            self._untrack(client)
             return
         server.settimeout(0.25)
         self._track(server)
@@ -301,6 +314,7 @@ class FaultProxy:
         t.join()
         _quiet_close(client)
         _quiet_close(server)
+        self._untrack(client, server)
 
     def _park(self, client: socket.socket) -> None:
         """Hold a connection open during a partition, swallowing whatever

@@ -46,7 +46,39 @@ import threading
 import time
 from typing import Dict, FrozenSet, Optional, Tuple
 
+from ..obs import metrics as _metrics
+from ..obs.trace import TRACE_HEADER, TRACER
+
 AUTH_ENV = "MAPREDUCE_TPU_AUTH"
+
+# -- instruments (one family each; the endpoint label splits planes) --------
+_ATTEMPTS = _metrics.counter(
+    "mrtpu_http_attempts_total",
+    "HTTP request attempts, including the first send (labels: endpoint)")
+_RETRIES = _metrics.counter(
+    "mrtpu_http_retries_total",
+    "re-sends under the RetryPolicy (labels: endpoint, reason="
+    "transport|status)")
+_BACKOFF = _metrics.counter(
+    "mrtpu_http_backoff_seconds_total",
+    "seconds spent sleeping between retry attempts")
+_RETRYABLE = _metrics.counter(
+    "mrtpu_http_retryable_status_total",
+    "retryable HTTP statuses received (labels: endpoint, status)")
+_EXHAUSTED = _metrics.counter(
+    "mrtpu_http_exhausted_total",
+    "calls that failed every attempt / ran out their deadline")
+_LATENCY = _metrics.histogram(
+    "mrtpu_http_request_seconds",
+    "whole-call latency of requests answered with a non-error status, "
+    "measured from handle-lock acquisition (labels: endpoint)")
+_BREAKER = _metrics.counter(
+    "mrtpu_breaker_transitions_total",
+    "circuit-breaker state transitions (labels: endpoint, transition="
+    "open|half_open|close)")
+_BREAKER_FAST_FAIL = _metrics.counter(
+    "mrtpu_breaker_fast_fails_total",
+    "calls refused while the circuit was open (labels: endpoint)")
 
 
 class RetryError(IOError):
@@ -139,13 +171,17 @@ def blob_policy(policy: Optional[RetryPolicy]) -> RetryPolicy:
 
 class _Breaker:
     """Per-endpoint circuit breaker state (thread-safe; one per client
-    handle, which the docstore/blob planes each keep per endpoint)."""
+    handle, which the docstore/blob planes each keep per endpoint).
+    Every state transition lands in ``mrtpu_breaker_transitions_total``
+    so a chaos run's open/half-open/close history is scrapeable."""
 
-    def __init__(self, policy: RetryPolicy) -> None:
+    def __init__(self, policy: RetryPolicy, endpoint: str = "?") -> None:
         self._policy = policy
+        self._endpoint = endpoint
         self._lock = threading.Lock()
         self._consecutive = 0
         self._opened_at: Optional[float] = None
+        self._half_open = False  # transition recorded for this open spell
 
     def allow(self) -> bool:
         if self._policy.breaker_threshold <= 0:
@@ -156,8 +192,15 @@ class _Breaker:
             if (time.monotonic() - self._opened_at
                     >= self._policy.breaker_cooldown):
                 # half-open: let this probe through; a failure re-opens
-                # (record_failure re-stamps opened_at), a success closes
+                # (record_failure re-stamps opened_at), a success closes.
+                # The transition counter records the STATE CHANGE once,
+                # not every probe admitted while half-open.
+                if not self._half_open:
+                    self._half_open = True
+                    _BREAKER.inc(endpoint=self._endpoint,
+                                 transition="half_open")
                 return True
+            _BREAKER_FAST_FAIL.inc(endpoint=self._endpoint)
             return False
 
     def record_failure(self) -> None:
@@ -166,12 +209,21 @@ class _Breaker:
         with self._lock:
             self._consecutive += 1
             if self._consecutive >= self._policy.breaker_threshold:
+                if self._opened_at is None:
+                    _BREAKER.inc(endpoint=self._endpoint,
+                                 transition="open")
+                # a failure while already open (e.g. a failed half-open
+                # probe) re-stamps the cooldown without a new transition
                 self._opened_at = time.monotonic()
+                self._half_open = False
 
     def record_success(self) -> None:
         with self._lock:
+            if self._opened_at is not None:
+                _BREAKER.inc(endpoint=self._endpoint, transition="close")
             self._consecutive = 0
             self._opened_at = None
+            self._half_open = False
 
 def split_embedded_token(address: str):
     """``[TOKEN@]HOST:PORT`` -> ``(token_or_None, "HOST:PORT")`` — the one
@@ -256,7 +308,8 @@ class KeepAliveClient:
                                or default_auth_token())
         self._cnn: Optional[http.client.HTTPConnection] = None
         self._lock = threading.Lock()
-        self._breaker = _Breaker(self.retry)
+        self.endpoint = f"{host}:{port}"
+        self._breaker = _Breaker(self.retry, endpoint=self.endpoint)
 
     @classmethod
     def from_address(cls, address: str, timeout: float = 60.0,
@@ -295,8 +348,16 @@ class KeepAliveClient:
         headers = dict(headers or {})
         if self.auth_token is not None:
             headers.setdefault("Authorization", f"Bearer {self.auth_token}")
+        ctx = TRACER.trace_context()
+        if ctx is not None:  # propagate the caller's span across the wire
+            headers.setdefault(TRACE_HEADER, ctx)
         policy = self.retry
+        endpoint = self.endpoint
         with self._lock:
+            # latency clock starts AFTER the handle lock: time spent
+            # queued behind another thread's backoff sleep is contention,
+            # not this request's latency
+            t_call = time.monotonic()
             # the breaker gates ADMISSION of a call, not attempts within
             # one: a call admitted while the circuit was closed keeps its
             # whole attempt/deadline budget even if its own failures trip
@@ -318,14 +379,22 @@ class KeepAliveClient:
                     pause = min(policy.backoff(attempt),
                                 give_up_at - time.monotonic())
                     if pause > 0:
+                        _BACKOFF.inc(pause, endpoint=endpoint)
                         time.sleep(pause)
                 remaining = give_up_at - time.monotonic()
                 if attempt and remaining <= 0:
                     break
+                if attempt:
+                    # counted only once the re-send actually happens —
+                    # after the deadline check, not before it
+                    _RETRIES.inc(endpoint=endpoint,
+                                 reason=("status" if last_status is not None
+                                         else "transport"))
                 # the deadline bounds the WHOLE call, so it also clips this
                 # attempt's socket wait — a blackholed endpoint costs at
                 # most the remaining budget, never the full socket timeout
                 attempt_timeout = max(min(self.timeout, remaining), 0.001)
+                _ATTEMPTS.inc(endpoint=endpoint)
                 try:
                     if self._cnn is None:
                         self._cnn = http.client.HTTPConnection(
@@ -353,11 +422,18 @@ class KeepAliveClient:
                     # transient server-side refusal: drop the connection
                     # (a 503-ing hop may have poisoned the keep-alive
                     # stream) and re-send after backoff
+                    _RETRYABLE.inc(endpoint=endpoint, status=str(status))
                     self._cnn.close()
                     self._cnn = None
                     last_exc, last_status = None, status
                     continue
+                if status < 400:
+                    # 4xx/5xx answers (404 probe misses, 401, 500) are
+                    # the caller's problem, not request-latency samples
+                    _LATENCY.observe(time.monotonic() - t_call,
+                                     endpoint=endpoint)
                 return status, data
+            _EXHAUSTED.inc(endpoint=endpoint)
             msg = (f"{method} {path} to {self.host}:{self.port} failed "
                    f"after {policy.max_attempts} attempts / "
                    f"{deadline}s deadline")

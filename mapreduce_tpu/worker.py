@@ -22,11 +22,35 @@ from typing import Any, Dict, List, Optional
 from .coord.connection import Connection
 from .coord.job import Job
 from .coord.task import LeaseLostError, Task
+from .obs import metrics as _metrics
+from .obs.trace import TRACER
 from .utils.constants import (
     TASK_STATUS, DEFAULT_SLEEP, DEFAULT_MAX_SLEEP, DEFAULT_MAX_ITER,
     DEFAULT_MAX_TASKS, DEFAULT_HEARTBEAT, MAX_WORKER_RETRIES)
 
 logger = logging.getLogger("mapreduce_tpu.worker")
+
+_CLAIMS = _metrics.counter(
+    "mrtpu_worker_claims_total",
+    "claim-poll outcomes (labels: worker, outcome=claimed|idle|"
+    "unreachable)")
+_HEARTBEATS = _metrics.counter(
+    "mrtpu_worker_heartbeats_total",
+    "heartbeat outcomes (labels: worker, outcome=ok|error|lost)")
+_LEASE_LOST = _metrics.counter(
+    "mrtpu_worker_lease_lost_total",
+    "jobs fenced after a confirmed lease loss (labels: worker)")
+_JOBS = _metrics.counter(
+    "mrtpu_worker_jobs_total",
+    "jobs this worker finished, by outcome (labels: worker, phase, "
+    "outcome=written|broken|fenced)")
+_JOB_SECONDS = _metrics.histogram(
+    "mrtpu_worker_job_seconds",
+    "wall seconds from claim to job outcome (labels: worker, phase)")
+_CONSEC_FAILURES = _metrics.gauge(
+    "mrtpu_worker_consecutive_failures",
+    "current unbroken run of job failures (labels: worker); "
+    "MAX_WORKER_RETRIES ends the worker")
 
 
 class Worker:
@@ -68,8 +92,11 @@ class Worker:
                     # network failure: ownership is UNKNOWN (the lease may
                     # still be live server-side), so keep beating — fencing
                     # on a guess would abort healthy jobs during a blip
+                    _HEARTBEATS.inc(worker=self.name, outcome="error")
                     logger.exception("heartbeat failed")
                     continue
+                _HEARTBEATS.inc(worker=self.name,
+                                outcome="ok" if owned else "lost")
                 if not owned and not stop.is_set():
                     # (the heartbeat query matches this claim's WRITTEN
                     # too, so completion races report ownership; the stop
@@ -82,6 +109,7 @@ class Worker:
                     logger.warning(
                         "%s: lease lost on job %s — fencing this run",
                         self.name, job.get_id())
+                    _LEASE_LOST.inc(worker=self.name)
                     fence.set()
                     return
 
@@ -102,6 +130,7 @@ class Worker:
         worked = False
         failures = 0  # CONSECUTIVE failures; reset by every success
         while iter_count < self.max_iter:
+            t_claim0 = time.monotonic()
             try:
                 job_tbl, status = self.task.take_next_job(
                     self.name, Task.tmpname())
@@ -112,64 +141,90 @@ class Worker:
                 # reset): an idle poll, not a death sentence — back off
                 # like any idle iteration; a board that never comes back
                 # exhausts max_iter and the worker exits normally
+                _CLAIMS.inc(worker=self.name, outcome="unreachable")
                 logger.warning("%s: job board unreachable (%s); "
                                "backing off", self.name, exc)
                 iter_count += 1
                 time.sleep(sleep)
                 sleep = min(sleep * 1.5, self.max_sleep)
                 continue
+            t_claim1 = time.monotonic()
             if job_tbl is not None:
+                _CLAIMS.inc(worker=self.name, outcome="claimed")
                 fence = threading.Event()
                 self.current_fence = fence
                 job = Job(self.cnn, job_tbl, status, self.task.tbl,
                           self.task.jobs_ns(), fence=fence)
                 logger.info("%s: running %s job %s", self.name,
                             status.value, job.get_id())
-                try:
-                    self._run_job(job, fence)
-                    if status == TASK_STATUS.MAP:
-                        self.task.note_written_map_job(job.get_id())
-                    self.jobs_done += 1
-                    worked = True
-                    # a success proves this worker is healthy: only an
-                    # unbroken run of failures should end it, or a
-                    # long-lived worker's occasional transient faults
-                    # accumulate into a lifetime death sentence
-                    failures = 0
-                except LeaseLostError:
-                    # fenced, not failed: the job was reaped/re-issued
-                    # (e.g. a partition outlasted job_lease) and its new
-                    # owner runs it now.  This worker is healthy — don't
-                    # mark BROKEN (the claim guard wouldn't match anyway),
-                    # don't count it toward giving up.
-                    logger.warning("%s: job %s fenced after lease loss",
-                                   self.name, job.get_id())
-                except Exception as exc:
-                    # xpcall shield: mark BROKEN, report, maybe give up
-                    # (worker.lua:112-138)
-                    logger.exception("%s: job %s failed", self.name,
-                                     job.get_id())
+                outcome = "written"
+                # the root span is backdated to the claim RPC so the
+                # trace shows claim -> run -> write nested under one
+                # per-job trace id (the acceptance-criterion shape)
+                with TRACER.span("job", start=t_claim0,
+                                 job=job.get_id(), phase=status.value,
+                                 worker=self.name) as root:
+                    TRACER.record("claim", t_claim0, t_claim1,
+                                  worker=self.name, job=job.get_id())
                     try:
-                        job.mark_as_broken()
-                        self.cnn.insert_exception(self.name, exc)
-                    except Exception:
-                        # the BROKEN mark and the errors channel ride the
-                        # same network as the board; when the job failed
-                        # BECAUSE of a partition these fail too.  Keep the
-                        # shield: the lease reaper re-issues the job either
-                        # way, a dead worker thread helps nobody.
-                        logger.exception(
-                            "%s: could not report job failure", self.name)
-                    failures += 1
-                    if failures >= MAX_WORKER_RETRIES:
-                        logger.error(
-                            "%s: %d consecutive failures, giving up on "
-                            "task (worker.lua:133-137)", self.name,
-                            failures)
-                        return worked
+                        self._run_job(job, fence)
+                        if status == TASK_STATUS.MAP:
+                            self.task.note_written_map_job(job.get_id())
+                        self.jobs_done += 1
+                        worked = True
+                        # a success proves this worker is healthy: only an
+                        # unbroken run of failures should end it, or a
+                        # long-lived worker's occasional transient faults
+                        # accumulate into a lifetime death sentence
+                        failures = 0
+                    except LeaseLostError:
+                        # fenced, not failed: the job was reaped/re-issued
+                        # (e.g. a partition outlasted job_lease) and its
+                        # new owner runs it now.  This worker is healthy —
+                        # don't mark BROKEN (the claim guard wouldn't
+                        # match anyway), don't count it toward giving up.
+                        outcome = "fenced"
+                        logger.warning(
+                            "%s: job %s fenced after lease loss",
+                            self.name, job.get_id())
+                    except Exception as exc:
+                        # xpcall shield: mark BROKEN, report, maybe give up
+                        # (worker.lua:112-138)
+                        outcome = "broken"
+                        logger.exception("%s: job %s failed", self.name,
+                                         job.get_id())
+                        try:
+                            job.mark_as_broken()
+                            self.cnn.insert_exception(self.name, exc)
+                        except Exception:
+                            # the BROKEN mark and the errors channel ride
+                            # the same network as the board; when the job
+                            # failed BECAUSE of a partition these fail
+                            # too.  Keep the shield: the lease reaper
+                            # re-issues the job either way, a dead worker
+                            # thread helps nobody.
+                            logger.exception(
+                                "%s: could not report job failure",
+                                self.name)
+                        failures += 1
+                    finally:
+                        root.args["outcome"] = outcome
+                        _JOBS.inc(worker=self.name, phase=status.value,
+                                  outcome=outcome)
+                        _JOB_SECONDS.observe(
+                            time.monotonic() - t_claim0,
+                            worker=self.name, phase=status.value)
+                        _CONSEC_FAILURES.set(failures, worker=self.name)
+                if failures >= MAX_WORKER_RETRIES:
+                    logger.error(
+                        "%s: %d consecutive failures, giving up on "
+                        "task (worker.lua:133-137)", self.name,
+                        failures)
+                    return worked
                 iter_count = 0
                 sleep = self.sleep
                 continue
+            _CLAIMS.inc(worker=self.name, outcome="idle")
             if status == TASK_STATUS.FINISHED:
                 return worked
             # idle: exponential backoff (worker.lua:97-103)

@@ -32,7 +32,9 @@ CHAOS_RETRY = RetryPolicy(max_attempts=8, base_delay=0.02, max_delay=0.3,
                           deadline=20.0, breaker_threshold=0)
 
 
-pytestmark = pytest.mark.chaos
+# telemetry: a failing chaos scenario dumps its /metrics + trace as
+# artifacts (conftest.py hook) — flakes arrive with their own evidence
+pytestmark = [pytest.mark.chaos, pytest.mark.telemetry]
 
 
 @pytest.fixture(autouse=True)

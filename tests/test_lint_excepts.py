@@ -1,11 +1,20 @@
-"""Tree-wide lint: no silent broad exception swallows in the package.
+"""Tree-wide AST lints for the package.
 
-``except Exception: pass`` (or a bare/except-BaseException pass) hides
-exactly the failures this codebase is built to surface — a fault-tolerant
-system that eats its own faults is untestable.  Narrow swallows
-(``except FileNotFoundError: pass``) stay legal; a broad handler must at
-least log.  AST-based so comments/strings can't fool it and formatting
-can't evade it."""
+1. No silent broad exception swallows: ``except Exception: pass`` (or a
+   bare/except-BaseException pass) hides exactly the failures this
+   codebase is built to surface — a fault-tolerant system that eats its
+   own faults is untestable.  Narrow swallows
+   (``except FileNotFoundError: pass``) stay legal; a broad handler must
+   at least log.
+2. No ``time.time()`` outside the timestamp allowlist: wall-clock
+   duration arithmetic corrupts ``real_time``/``cluster_time`` when NTP
+   steps the clock mid-run (the satellite fix of the observability PR).
+   Durations use ``time.monotonic()``; wall-clock timestamps are minted
+   in ONE place (coord/docstore.now) and compared, never subtracted
+   pairwise on one host.
+
+AST-based so comments/strings can't fool them and formatting can't
+evade them."""
 
 import ast
 import os
@@ -50,3 +59,55 @@ def test_no_silent_broad_excepts_in_package():
     assert not offenders, (
         "silent broad exception swallows (except Exception/bare: pass) — "
         "log or narrow them: " + ", ".join(offenders))
+
+
+#: the only places wall-clock reads are legal, because they mint or
+#: compare persisted TIMESTAMP fields (started_time / written_time /
+#: lease_expires / the statusz "now"), never compute durations:
+#:   * coord/docstore.py — now(), the one wall-clock mint point;
+#:   * obs/statusz.py — compares lease_expires stamps against now.
+_WALL_CLOCK_ALLOWLIST = {
+    os.path.join("mapreduce_tpu", "coord", "docstore.py"),
+    os.path.join("mapreduce_tpu", "obs", "statusz.py"),
+}
+
+
+def _is_time_time_call(node: ast.AST) -> bool:
+    """Matches ``time.time()`` and ``<alias>.time()`` where the module
+    was imported as ``import time as <alias>``, plus a bare ``time()``
+    bound by ``from time import time``.  Module-level aliasing is rare
+    enough here that matching attribute name ``time`` on any Name base
+    called ``time``-ish is overkill; we match the two spellings the
+    codebase could realistically use."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr == "time"
+            and isinstance(f.value, ast.Name) and f.value.id == "time"):
+        return True
+    return isinstance(f, ast.Name) and f.id == "time"
+
+
+def test_no_wall_clock_time_outside_allowlist():
+    """``time.time()`` is banned in the package: every use is either
+    duration arithmetic (must be time.monotonic()) or a persisted
+    timestamp (must go through coord/docstore.now so there is one mint
+    point to reason about)."""
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(PKG_ROOT))
+            if rel in _WALL_CLOCK_ALLOWLIST:
+                continue
+            with open(path, "r") as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if _is_time_time_call(node):
+                    offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "wall-clock time.time() outside the timestamp allowlist — use "
+        "time.monotonic() for durations, docstore.now() for persisted "
+        "timestamps: " + ", ".join(offenders))

@@ -1,0 +1,163 @@
+"""Chaos-scrape tests: the exposition plane under injected faults.
+
+The acceptance criterion of the observability PR: during a
+fault-injected run, ``/metrics`` must keep serving valid Prometheus
+text, and after the run the retry and breaker-transition counters the
+chaos actually exercised must be nonzero — telemetry that stays up and
+truthful while the system it watches is being hurt."""
+
+import threading
+import time
+import uuid
+
+import pytest
+
+from mapreduce_tpu import spec
+from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+from mapreduce_tpu.examples import naive
+from mapreduce_tpu.obs.metrics import REGISTRY, parse_prometheus
+from mapreduce_tpu.server import Server
+from mapreduce_tpu.storage.httpstore import BlobServer
+from mapreduce_tpu.testing.faults import FaultProxy, FaultSchedule
+from mapreduce_tpu.utils.httpclient import CircuitOpenError, RetryPolicy
+from mapreduce_tpu.worker import spawn_worker_threads
+from tests import chaos_mods
+
+M = "tests.chaos_mods"
+
+CHAOS_RETRY = RetryPolicy(max_attempts=8, base_delay=0.02, max_delay=0.3,
+                          deadline=20.0, breaker_threshold=0)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.telemetry]
+
+
+@pytest.fixture(autouse=True)
+def fresh_modules():
+    spec.clear_caches()
+    yield
+    spec.clear_caches()
+
+
+def test_metrics_scrape_stays_parseable_through_blob_5xx_storm(
+        tmp_path):
+    """Workers ride out a 503 storm on the blob plane while a scraper
+    hammers the board's /metrics: every scrape parses, and the final
+    exposition proves the storm happened (503 counts + retries > 0)."""
+    corpus = []
+    for i in range(4):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(f"alpha beta f{i} gamma alpha\n" * 5)
+        corpus.append(str(p))
+    board = DocServer().start_background()
+    blob = BlobServer(str(tmp_path / "blobs")).start_background()
+    sched = FaultSchedule()
+    storm = sched.http_error(for_secs=0.4, status=503)
+    proxy = FaultProxy(blob.host, blob.port, schedule=sched).start()
+    scrape_errors = []
+    scrapes = []
+    stop = threading.Event()
+
+    def scraper():
+        s = HttpDocStore(f"{board.host}:{board.port}")
+        try:
+            while not stop.is_set():
+                try:
+                    scrapes.append(parse_prometheus(s.metrics_text()))
+                except Exception as exc:  # any failure = criterion lost
+                    scrape_errors.append(repr(exc))
+                time.sleep(0.05)
+        finally:
+            s.close()
+
+    t_scrape = threading.Thread(target=scraper, daemon=True)
+    t_scrape.start()
+    try:
+        chaos_mods.reset(corpus)
+        params = {r: M for r in ("taskfn", "mapfn", "partitionfn",
+                                 "reducefn", "finalfn")}
+        params["storage"] = f"http:{proxy.address}"
+        connstr = f"http://{board.host}:{board.port}"
+        threads = spawn_worker_threads(connstr, "obsx", 2,
+                                       retry=CHAOS_RETRY)
+        server = Server(connstr, "obsx", retry=CHAOS_RETRY)
+        server.configure(params)
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        stop.set()
+        t_scrape.join(timeout=10)
+        proxy.stop()
+        blob.shutdown()
+
+    try:
+        assert storm.hits > 0, "no 503 ever served — storm not exercised"
+        assert chaos_mods.RESULT == naive.wordcount(corpus)
+        assert stats["map"]["failed"] == 0
+        # exposition stayed up and parseable throughout the fault window
+        assert not scrape_errors, f"scrapes failed mid-fault: " \
+                                  f"{scrape_errors[:3]}"
+        assert scrapes, "scraper never completed a scrape"
+        final = scrapes[-1]
+        endpoint = proxy.address
+
+        def series(name, **labels):
+            want = tuple(sorted((k, str(v)) for k, v in labels.items()))
+            return sum(v for (n, lk), v in final.items()
+                       if n == name and set(want) <= set(lk))
+
+        # the blob plane's storm shows in the scraped counters
+        assert series("mrtpu_http_retryable_status_total",
+                      endpoint=endpoint, status="503") > 0
+        assert series("mrtpu_http_retries_total", endpoint=endpoint) > 0
+        # and the docserver counted its own RPC traffic
+        assert series("mrtpu_docserver_requests_total", outcome="ok") > 0
+        assert series("mrtpu_worker_jobs_total", outcome="written") > 0
+    finally:
+        board.shutdown()
+
+
+def test_breaker_transitions_visible_in_scrape():
+    """A dead endpoint trips the breaker open, the cooldown half-opens
+    it, a healed endpoint closes it — and all three transitions are
+    scrapeable from /metrics, not just observable as exceptions."""
+    board = DocServer().start_background()
+    proxy = FaultProxy(board.host, board.port).start()
+    endpoint = proxy.address
+    pol = RetryPolicy(max_attempts=1, base_delay=0.01, deadline=0.3,
+                      breaker_threshold=2, breaker_cooldown=0.1)
+    store = HttpDocStore(proxy.address, retry=pol)
+    scrape = HttpDocStore(f"{board.host}:{board.port}")
+    try:
+        proxy.partition()
+        for _ in range(2):  # transport failures reach the threshold
+            with pytest.raises(OSError):
+                store.ping()
+        with pytest.raises(CircuitOpenError):
+            store.ping()  # open: fail fast
+        proxy.heal()
+        time.sleep(0.15)  # past breaker_cooldown: next call half-opens
+        assert store.ping()  # probe succeeds -> close
+
+        parsed = parse_prometheus(scrape.metrics_text())
+
+        def transitions(kind):
+            return parsed.get(
+                ("mrtpu_breaker_transitions_total",
+                 (("endpoint", endpoint), ("transition", kind))), 0)
+
+        assert transitions("open") >= 1
+        assert transitions("half_open") >= 1
+        assert transitions("close") >= 1
+        assert parsed.get(
+            ("mrtpu_breaker_fast_fails_total",
+             (("endpoint", endpoint),)), 0) >= 1
+        # the registry agrees with its own exposition
+        assert REGISTRY.value("mrtpu_breaker_transitions_total",
+                              endpoint=endpoint,
+                              transition="open") == transitions("open")
+    finally:
+        store.close()
+        scrape.close()
+        proxy.stop()
+        board.shutdown()

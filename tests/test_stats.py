@@ -1,0 +1,130 @@
+"""Stats-plane coverage: the persisted stats doc's shape, its agreement
+with the registry-backed /metrics values after a full wordcount cycle,
+device-timing persistence, and the monotonic-duration guarantees the
+satellite clock fix introduced."""
+
+import uuid
+
+import pytest
+
+from mapreduce_tpu import spec
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.server import Server
+from mapreduce_tpu.utils.constants import STATUS, TASK_STATUS
+from mapreduce_tpu.worker import spawn_worker_threads
+
+
+@pytest.fixture(autouse=True)
+def fresh_modules():
+    spec.clear_caches()
+    yield
+    spec.clear_caches()
+
+
+def _run_wordcount(tmp_path, n_files=4):
+    files = []
+    for i in range(n_files):
+        p = tmp_path / f"s{i}.txt"
+        p.write_text(f"alpha beta s{i} gamma alpha\n" * 5)
+        files.append(str(p))
+    connstr = f"mem://{uuid.uuid4().hex}"
+    m = "mapreduce_tpu.examples.wordcount"
+    params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
+                             "reducefn", "finalfn")}
+    params["storage"] = f"mem:{uuid.uuid4().hex}"
+    params["init_args"] = {"files": files, "num_reducers": 3}
+    threads = spawn_worker_threads(connstr, "st", 2)
+    server = Server(connstr, "st")
+    server.configure(params)
+    stats = server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    return server, stats
+
+
+def test_compute_stats_shape_after_full_cycle(tmp_path):
+    _, stats = _run_wordcount(tmp_path)
+    for phase in ("map", "reduce"):
+        d = stats[phase]
+        assert set(d) == {"count", "failed", "sum_cpu_time",
+                          "sum_real_time", "cluster_time"}
+        assert d["failed"] == 0
+        assert d["count"] > 0
+        assert d["sum_real_time"] >= 0.0
+        assert d["cluster_time"] >= 0.0
+    assert stats["map"]["count"] == 4  # one map job per file
+    assert stats["cluster_time"] == pytest.approx(
+        stats["map"]["cluster_time"] + stats["reduce"]["cluster_time"])
+    assert stats["iteration"] == 1
+    assert "device" not in stats  # host plane: no device block
+
+
+def test_stats_doc_matches_registry(tmp_path):
+    """The drift-proofing contract: the persisted stats doc is BUILT from
+    registry reads, so every field must equal the live gauge /metrics
+    would serve."""
+    server, stats = _run_wordcount(tmp_path)
+    # the db label isolates this task's series from any other Server in
+    # the process (multi-task boards are supported)
+    for phase in ("map", "reduce"):
+        assert stats[phase]["count"] == REGISTRY.value(
+            "mrtpu_stats_jobs", db="st", phase=phase, state="all")
+        assert stats[phase]["failed"] == REGISTRY.value(
+            "mrtpu_stats_jobs", db="st", phase=phase, state="failed")
+        for field, key in (("cpu", "sum_cpu_time"),
+                           ("real", "sum_real_time"),
+                           ("cluster", "cluster_time")):
+            assert stats[phase][key] == REGISTRY.value(
+                "mrtpu_stats_seconds", db="st", phase=phase, field=field)
+    assert stats["cluster_time"] == REGISTRY.value(
+        "mrtpu_stats_seconds", db="st", phase="total", field="cluster")
+    assert stats["iteration"] == REGISTRY.value("mrtpu_stats_iteration",
+                                                db="st")
+    # and the doc the board persisted is the same object content
+    assert server.task.tbl["stats"] == stats
+
+
+def test_device_timings_persisted_when_present(tmp_path):
+    """A device-phase run records engine timings into the stats doc and
+    the mrtpu_stats_device gauge (simulated device phase: the stats
+    machinery is plane-agnostic by design)."""
+    connstr = f"mem://{uuid.uuid4().hex}"
+    server = Server(connstr, "dv")
+    server.configure({r: "mapreduce_tpu.examples.wordcount"
+                      for r in ("taskfn", "mapfn", "partitionfn",
+                                "reducefn", "finalfn")}
+                     | {"storage": f"mem:{uuid.uuid4().hex}",
+                        "init_args": {"files": [], "num_reducers": 1}})
+    server.task.create_collection(TASK_STATUS.WAIT, server.params, 1)
+    server._last_device_timings = {
+        "waves": 2, "upload_s": 0.5, "compute_s": 1.25, "readback_s": 0.1}
+    stats = server._compute_stats()
+    assert stats["device"] == server._last_device_timings
+    assert REGISTRY.value("mrtpu_stats_device", db="dv",
+                          field="compute_s") == 1.25
+    assert server.task.tbl["stats"]["device"]["waves"] == 2
+
+
+def test_real_time_survives_wall_clock_step(tmp_path, monkeypatch):
+    """The satellite clock fix: job real_time comes from the monotonic
+    clock, so a (simulated) NTP step mid-job cannot corrupt it.  The
+    wall clock jumping BACK an hour while a job runs used to yield a
+    negative real_time; now the duration must stay sane."""
+    from mapreduce_tpu.coord import docstore
+
+    step = {"offset": 0.0}
+    base_now = docstore.now
+
+    def stepped_now():
+        return base_now() + step["offset"]
+
+    monkeypatch.setattr(docstore, "now", stepped_now)
+    server, stats = _run_wordcount(tmp_path, n_files=2)
+    # the persisted per-phase durations are monotonic sums: never negative
+    assert stats["map"]["sum_real_time"] >= 0.0
+    assert stats["reduce"]["sum_real_time"] >= 0.0
+    step["offset"] = -3600.0
+    # a stats recompute after the step still yields sane durations
+    # (started/written stamps were minted before the step)
+    stats2 = server._compute_stats()
+    assert stats2["map"]["sum_real_time"] == stats["map"]["sum_real_time"]
