@@ -21,6 +21,14 @@ test.sh:10).
 Prints ONE JSON line:
     {"metric": "europarl_wordcount_host_wall_s", "value": <s>,
      "unit": "s", "vs_baseline": <47.372 / s>, "workers": N, ...}
+
+``--smoke`` runs the tier-1-safe mode instead: a small corpus driven
+twice in-process — once over the SERIAL claim path (claim_batch=1, no
+claim-ahead), once over the PIPELINED one (defaults) — asserting from
+the metrics registry that board claim RPCs per job dropped.  No
+wall-clock comparisons, so it cannot flake on load.  Both modes merge
+their result into BENCH_HOST.json ("after" / "smoke" keys; "before"
+holds the pre-pipelining measurement).
 """
 
 from __future__ import annotations
@@ -36,6 +44,115 @@ BASELINE_4W_S = 47.372       # reference README.md:70 (4 workers)
 BASELINE_1W_S = 146.53       # reference README.md:77
 BASELINE_30W_S = 32.0        # reference README.md:79
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _merge_bench_json(key: str, payload: dict) -> str:
+    """Merge one run's result into BENCH_HOST.json under *key*."""
+    path = os.path.join(REPO, "BENCH_HOST.json")
+    data = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            data = {}
+    data[key] = payload
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, default=float)
+        f.write("\n")
+    return path
+
+
+def smoke() -> int:
+    """Tier-1-safe pipelining assertion: same small workload, serial vs
+    pipelined claim path, judged ONLY by RPC counters from the obs
+    registry (board claim round trips per job must drop)."""
+    import shutil
+    import uuid
+
+    from mapreduce_tpu.coord.docserver import DocServer
+    from mapreduce_tpu.obs.metrics import REGISTRY
+    from mapreduce_tpu.server import Server
+    from mapreduce_tpu.storage import BlobServer
+    from mapreduce_tpu.worker import spawn_worker_threads
+
+    n_files, n_reducers, workers = 12, 5, 2
+    corpus_dir = tempfile.mkdtemp(prefix="bench_host_smoke_")
+    files = []
+    for i in range(n_files):
+        p = os.path.join(corpus_dir, f"f{i}.txt")
+        with open(p, "w") as f:
+            f.write(f"smoke words w{i % 4} alpha beta gamma\n" * 30)
+        files.append(p)
+
+    def claim_rpcs() -> float:
+        return (REGISTRY.value("mrtpu_docserver_requests_total",
+                               op="find_and_modify", outcome="ok")
+                + REGISTRY.value("mrtpu_docserver_requests_total",
+                                 op="find_and_modify_many", outcome="ok"))
+
+    def wire_bytes() -> float:
+        return (REGISTRY.sum("mrtpu_blob_wire_bytes_total",
+                             direction="put")
+                + REGISTRY.sum("mrtpu_blob_wire_bytes_total",
+                               direction="get"))
+
+    def run(conf, compress):
+        board = DocServer().start_background()
+        blob_root = tempfile.mkdtemp(prefix="bench_host_smoke_blobs_")
+        blob = BlobServer(blob_root,
+                          gzip_enabled=compress).start_background()
+        db = f"sm{uuid.uuid4().hex[:6]}"
+        m = "mapreduce_tpu.examples.wordcount"
+        params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
+                                 "reducefn", "finalfn")}
+        params["combinerfn"] = m
+        params["storage"] = f"http:{blob.host}:{blob.port}"
+        params["init_args"] = {"files": files,
+                               "num_reducers": n_reducers}
+        c0, w0 = claim_rpcs(), wire_bytes()
+        threads = spawn_worker_threads(board.connstr, db, workers,
+                                       conf=conf)
+        server = Server(board.connstr, db)
+        server.configure(params)
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=60)
+        board.shutdown()
+        blob.shutdown()
+        shutil.rmtree(blob_root, ignore_errors=True)
+        jobs = stats["map"]["count"] + stats["reduce"]["count"]
+        assert stats["map"]["failed"] == 0
+        assert stats["reduce"]["failed"] == 0
+        assert jobs == n_files + n_reducers, (jobs, n_files, n_reducers)
+        return {"jobs": jobs,
+                "claim_rpcs": claim_rpcs() - c0,
+                "claim_rpcs_per_job": round((claim_rpcs() - c0) / jobs,
+                                            3),
+                "blob_wire_bytes": wire_bytes() - w0}
+
+    # serial = the pre-pipelining wire shape: one claim RPC per job,
+    # no claim-ahead, identity transfers
+    serial = run({"claim_batch": 1, "claim_ahead": False},
+                 compress=False)
+    pipelined = run(None, compress=True)
+    result = {"mode": "smoke", "workers": workers,
+              "serial": serial, "pipelined": pipelined}
+    assert (pipelined["claim_rpcs_per_job"]
+            < serial["claim_rpcs_per_job"]), (
+        "pipelined claim path did not reduce board round trips per job: "
+        f"{pipelined} vs {serial}")
+    assert pipelined["blob_wire_bytes"] < serial["blob_wire_bytes"], (
+        "gzip negotiation did not reduce blob wire bytes")
+    path = _merge_bench_json("smoke", result)
+    print(json.dumps(result, default=float))
+    print(f"# smoke OK -> {path}: claim RPCs/job "
+          f"{serial['claim_rpcs_per_job']} -> "
+          f"{pipelined['claim_rpcs_per_job']}, blob wire bytes "
+          f"{serial['blob_wire_bytes']:.0f} -> "
+          f"{pipelined['blob_wire_bytes']:.0f}", file=sys.stderr)
+    shutil.rmtree(corpus_dir, ignore_errors=True)
+    return 0
 
 
 def split_corpus(corpus: bytes, n_splits: int):
@@ -55,9 +172,6 @@ def split_corpus(corpus: bytes, n_splits: int):
 
 def main() -> None:
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
-    smoke = "--smoke" in sys.argv
-    if smoke:
-        scale = 0.002
     workers = int(os.environ.get("BENCH_WORKERS", "4"))
     for i, a in enumerate(sys.argv):
         if a == "--workers":
@@ -163,6 +277,7 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": round(BASELINE_4W_S / wall, 2),
         "workers": workers,
+        "scale": scale,
         "splits": len(names),
         "reducers": n_reducers,
         "setup_s": round(setup_s, 1),
@@ -178,8 +293,11 @@ def main() -> None:
                 "reduce", {}).get("cluster_time", 0.0), 2),
         },
     }
+    _merge_bench_json("after", result)
     print(json.dumps(result, default=float))
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        raise SystemExit(smoke())
     main()

@@ -170,6 +170,12 @@ def cmd_worker(argv: List[str]) -> int:
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--max-sleep", type=float, default=None)
     p.add_argument("--max-tasks", type=int, default=None)
+    p.add_argument("--claim-batch", type=int, default=None, metavar="N",
+                   help="jobs claimed per board round trip (claim "
+                        "pipelining; 1 = the serial claim-per-job path)")
+    p.add_argument("--no-claim-ahead", action="store_true",
+                   help="do not overlap the next batch's claim RPC with "
+                        "the current job's execution")
     _add_auth(p)
     _add_retry(p)
     _add_trace(p)
@@ -181,8 +187,11 @@ def cmd_worker(argv: List[str]) -> int:
 
     conf = {k: v for k, v in (("max_iter", args.max_iter),
                               ("max_sleep", args.max_sleep),
-                              ("max_tasks", args.max_tasks))
+                              ("max_tasks", args.max_tasks),
+                              ("claim_batch", args.claim_batch))
             if v is not None}
+    if args.no_claim_ahead:
+        conf["claim_ahead"] = False
     retry = _retry_policy(args)
     if args.workers == 1:
         w = Worker(args.connstr, args.dbname, auth=args.auth, retry=retry)
@@ -282,6 +291,9 @@ def cmd_blobserver(argv: List[str]) -> int:
     p.add_argument("root", help="directory to store blobs in")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8750)
+    p.add_argument("--no-gzip", action="store_true",
+                   help="serve identity-only (no gzip negotiation); "
+                        "clients fall back automatically")
     _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
@@ -290,7 +302,8 @@ def cmd_blobserver(argv: List[str]) -> int:
     from .storage import BlobServer
 
     srv = BlobServer(args.root, args.host, args.port,
-                     auth_token=args.auth)
+                     auth_token=args.auth,
+                     gzip_enabled=not args.no_gzip)
     print(f"serving {args.root} at http:{srv.address} "
           f"(storage DSL: \"http:HOST:{srv.port}\")", flush=True)
     try:

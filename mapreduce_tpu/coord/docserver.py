@@ -70,8 +70,8 @@ _SCRAPES = _metrics.counter(
 # ops whose second application would change state: answered once, replayed
 # from the dedupe cache on retry.  Reads re-execute harmlessly.
 _MUTATING_OPS = frozenset(
-    {"insert", "insert_many", "update", "find_and_modify", "remove",
-     "drop_collection"})
+    {"insert", "insert_many", "update", "find_and_modify",
+     "find_and_modify_many", "remove", "drop_collection"})
 
 _DEDUPE_CAP = 4096   # answered-request ids remembered per server
 _SESSION_CAP = 1024  # per-client eviction watermarks remembered
@@ -276,6 +276,12 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                                 upsert=bool(req.get("upsert")))
         if op == "find_and_modify":
             return store.find_and_modify(coll, req["query"], req["update"])
+        if op == "find_and_modify_many":
+            # the batched claim: one rid-deduped round trip claims up to
+            # `limit` jobs (Task.take_next_jobs)
+            return store.find_and_modify_many(coll, req["query"],
+                                              req["update"],
+                                              int(req.get("limit", 1)))
         if op == "remove":
             return store.remove(coll, req.get("query"))
         if op == "drop_collection":
@@ -355,6 +361,9 @@ class HttpDocStore(DocStore):
         self.host, self.port = self._client.host, self._client.port
         self._rid_session = uuid.uuid4().hex
         self._rid_seq = itertools.count(1)
+        #: set after a server rejects find_and_modify_many as unknown —
+        #: the client then falls back to serial claims for good
+        self._no_batched_claims = False
         # serializes rid allocation WITH the send: the eviction watermark
         # assumes this session's seqs arrive in order, so two threads
         # sharing the handle (claim loop + heartbeat) must not allocate
@@ -416,6 +425,23 @@ class HttpDocStore(DocStore):
                 "HttpDocStore.find_and_modify does not support sort_key")
         return self._rpc("find_and_modify", coll=coll, query=query,
                          update=update)
+
+    def find_and_modify_many(self, coll: str, query: Query, update: Doc,
+                             limit: int = 1) -> List[Doc]:
+        if self._no_batched_claims:
+            # a pre-batching server answered "unknown rpc op" once; keep
+            # speaking its dialect (one claim per round trip)
+            return DocStore.find_and_modify_many(self, coll, query,
+                                                 update, limit)
+        try:
+            return self._rpc("find_and_modify_many", coll=coll,
+                             query=query, update=update, limit=int(limit))
+        except ValueError as exc:
+            if "unknown rpc op" not in str(exc):
+                raise
+            self._no_batched_claims = True
+            return DocStore.find_and_modify_many(self, coll, query,
+                                                 update, limit)
 
     def remove(self, coll: str, query: Optional[Query] = None) -> int:
         return self._rpc("remove", coll=coll, query=query)

@@ -153,6 +153,23 @@ class DocStore:
         POST-update document (None if nothing matched)."""
         raise NotImplementedError
 
+    def find_and_modify_many(self, coll: str, query: Query, update: Doc,
+                             limit: int = 1) -> List[Doc]:
+        """Claim up to *limit* matching docs in one call, applying
+        *update* to each; returns the post-update documents (possibly
+        empty).  The batched form of the worker claim — one round trip
+        instead of *limit* (Task.take_next_jobs).  The base implementation
+        loops :meth:`find_and_modify`, which is correct for any store
+        whose claim update makes a doc stop matching (ours sets status
+        RUNNING); backends override for one-lock atomicity."""
+        out: List[Doc] = []
+        for _ in range(max(int(limit), 0)):
+            got = self.find_and_modify(coll, query, update)
+            if got is None:
+                break
+            out.append(got)
+        return out
+
     def remove(self, coll: str, query: Optional[Query] = None) -> int:
         raise NotImplementedError
 
@@ -242,6 +259,16 @@ class MemoryDocStore(DocStore):
                 docs.sort(key=sort_key)
             d = apply_update(docs[0], update)
             return copy.deepcopy(d)
+
+    def find_and_modify_many(self, coll, query, update, limit=1):
+        with self._lock:
+            out = []
+            for d in self._colls.get(coll, {}).values():
+                if len(out) >= limit:
+                    break
+                if matches(d, query):
+                    out.append(copy.deepcopy(apply_update(d, update)))
+            return out
 
     def remove(self, coll: str, query: Optional[Query] = None) -> int:
         with self._lock:
@@ -375,6 +402,18 @@ class DirDocStore(DocStore):
             d = apply_update(docs[0], update)
             self._write_doc(coll, d)
             return copy.deepcopy(d)
+
+    def find_and_modify_many(self, coll, query, update, limit=1):
+        with self._locked(coll):
+            out = []
+            for d in self._read_all(coll).values():
+                if len(out) >= limit:
+                    break
+                if matches(d, query):
+                    apply_update(d, update)
+                    self._write_doc(coll, d)
+                    out.append(copy.deepcopy(d))
+            return out
 
     def remove(self, coll: str, query: Optional[Query] = None) -> int:
         with self._locked(coll):

@@ -21,6 +21,7 @@ Intended-behavior decisions where the reference is quirky (SURVEY.md §7):
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import re
 import time
@@ -234,10 +235,28 @@ class Job:
 
         result: Dict[Any, List[Any]] = {}
         keyorder: Dict[Any, Any] = {}
+        # sort_key memo for the scalar keys real workloads emit: emit is
+        # THE map hot loop and sort_key allocates a rank tuple per call.
+        # Two type-split caches, because dict keys compare by value across
+        # types (True == 1 == 1.0) while sort_key ranks them differently —
+        # and only exact str/int (not bool, not float) are cached, so a
+        # float key can never alias an int cache entry.
+        _sk_str: Dict[str, Any] = {}
+        _sk_int: Dict[int, Any] = {}
 
         def emit(key: Any, value: Any) -> None:
             self._check_fence()
-            sk = sort_key(key)
+            tk = type(key)
+            if tk is str:
+                sk = _sk_str.get(key)
+                if sk is None:
+                    sk = _sk_str[key] = sort_key(key)
+            elif tk is int:
+                sk = _sk_int.get(key)
+                if sk is None:
+                    sk = _sk_int[key] = sort_key(key)
+            else:
+                sk = sort_key(key)
             bucket = result.setdefault(sk, [])
             keyorder.setdefault(sk, key)
             bucket.append(value)
@@ -269,12 +288,29 @@ class Job:
         with TRACER.span("write", phase="map", job=self.get_id(),
                          partitions=len(per_part)):
             ns = map_results_prefix(self.path)
-            for part, lines in per_part.items():
+
+            def put_one(part: int, lines: List[str]) -> None:
                 self._check_fence()
                 b = self._storage.builder()
                 for line in lines:
                     b.write_record_line(line)
                 b.build(map_file_name(ns, part, self.get_id()))
+
+            items = list(per_part.items())
+            if len(items) > 1 and self._storage.scheme == "http":
+                # fan the per-partition PUTs out over the blob client's
+                # connection pool instead of serializing ~num_reducers
+                # round trips on one socket; local backends gain nothing
+                # from threads, so they keep the serial loop
+                with concurrent.futures.ThreadPoolExecutor(
+                        max_workers=min(len(items), 8)) as ex:
+                    futs = [ex.submit(put_one, part, lines)
+                            for part, lines in items]
+                    for f in futs:
+                        f.result()  # first failure (incl. a fence) raises
+            else:
+                for part, lines in items:
+                    put_one(part, lines)
 
     def _execute_reduce(self) -> None:
         """job_prepare_reduce (job.lua:230-296): merge all mappers' files

@@ -174,19 +174,36 @@ class Task:
 
     def take_next_job(self, worker_name: str, tmpname: str,
                       ) -> Tuple[Optional[JobDoc], TASK_STATUS]:
-        """Atomically claim one job for *worker_name*.
+        """Atomically claim one job for *worker_name* (the serial form of
+        :meth:`take_next_jobs`; kept for tests/tools and as the
+        batch-size-1 path).
 
         Returns ``(job_doc, task_status)``; job_doc is None when there is
         nothing claimable (caller sleeps) or the task is WAIT/FINISHED.
+        """
+        got, st = self.take_next_jobs(worker_name, tmpname, 1)
+        return (got[0] if got else None), st
+
+    def take_next_jobs(self, worker_name: str, tmpname: str, n: int = 1,
+                       ) -> Tuple[List[JobDoc], TASK_STATUS]:
+        """Atomically claim up to *n* jobs for *worker_name* in ONE board
+        round trip (find_and_modify_many, rid-deduped over http like any
+        mutating RPC — a retried batch claim cannot double-claim).
+
+        Every claimed doc carries the same ``(worker, tmpname)`` claim
+        stamp; claim identity stays per-job because ``_id`` is part of
+        the guard (job.Job._claim_query), so each claim in the batch is
+        leased, heartbeated and FENCED independently of its batch-mates.
         Reference: task.lua:258-343 — including the iteration>1 locality
         preference (claim own cached map jobs first, then fall back to
         BROKEN-only for MAX_IDLE_COUNT polls, then anything).
         """
+        n = max(int(n), 1)  # 0 would turn every poll into an idle poll
         if not self.update():
-            return None, TASK_STATUS.WAIT
+            return [], TASK_STATUS.WAIT
         st = self.status()
         if st in (TASK_STATUS.WAIT, TASK_STATUS.FINISHED):
-            return None, st
+            return [], st
         coll = self.jobs_ns()
         claimable = {"status": {"$in": [int(STATUS.WAITING),
                                         int(STATUS.BROKEN)]}}
@@ -212,13 +229,33 @@ class Task:
             "status": int(STATUS.RUNNING),
         }}
         store = self._cnn.connect()
+        got: List[JobDoc] = []
         for q in queries:
-            got = store.find_and_modify(coll, q, claim)
-            if got is not None:
-                self._idle_count = 0
-                return got, st
-        self._idle_count += 1
-        return None, st
+            want = n - len(got)
+            if want <= 0:
+                break
+            got.extend(store.find_and_modify_many(coll, q, claim, want))
+        if got:
+            self._idle_count = 0
+        else:
+            self._idle_count += 1
+        return got, st
+
+    def release_jobs(self, coll: str, job_tbls: List[JobDoc]) -> int:
+        """Hand claimed-but-never-started jobs straight back to WAITING
+        (claim-guarded, RUNNING only) so an exiting worker's claim-ahead
+        queue is reclaimable immediately instead of after a lease reap —
+        and without the spurious ``repetitions`` increment a reap charges.
+        Best-effort: if this RPC fails the lease reaper covers it."""
+        if not job_tbls:
+            return 0
+        guards = [{"_id": j["_id"], "worker": j.get("worker"),
+                   "tmpname": j.get("tmpname"),
+                   "status": int(STATUS.RUNNING)} for j in job_tbls]
+        return self._cnn.connect().update(
+            coll, {"$or": guards},
+            {"$set": {"status": int(STATUS.WAITING), "worker": None}},
+            multi=True)
 
     def heartbeat(self, job_tbl: JobDoc) -> bool:
         """Extend an in-flight job's lease (no reference equivalent — fixes
@@ -242,14 +279,51 @@ class Task:
         RUNNING/FINISHED)."""
         n = self._cnn.connect().update(
             self.jobs_ns(),
-            {"_id": job_tbl["_id"],
-             "worker": job_tbl.get("worker"),
-             "tmpname": job_tbl.get("tmpname"),
-             "status": {"$in": [int(STATUS.RUNNING),
-                                int(STATUS.FINISHED),
-                                int(STATUS.WRITTEN)]}},
+            self._beat_guard(job_tbl),
             {"$set": {"lease_expires": docstore.now() + self.job_lease}})
         return n > 0
+
+    @staticmethod
+    def _beat_guard(job_tbl: JobDoc) -> Dict[str, Any]:
+        return {"_id": job_tbl["_id"],
+                "worker": job_tbl.get("worker"),
+                "tmpname": job_tbl.get("tmpname"),
+                "status": {"$in": [int(STATUS.RUNNING),
+                                   int(STATUS.FINISHED),
+                                   int(STATUS.WRITTEN)]}}
+
+    def heartbeat_many(self, coll: str, job_tbls: List[JobDoc],
+                       ) -> List[bool]:
+        """Extend EVERY lease this worker holds (the running job plus its
+        claim-ahead queue) in one ``$or``-guarded multi-update — one RPC
+        per beat period however many claims are held.  Returns per-claim
+        ownership, same semantics as :meth:`heartbeat`.
+
+        Fencing stays per-claim: each ``$or`` arm is a full claim guard,
+        so the update can only touch docs this worker still owns.  When
+        the matched count says every claim is owned (the steady state)
+        that single RPC is the whole answer; a shortfall means at least
+        one lease is LOST, and each claim is then probed individually so
+        exactly the lost ones get fenced — never the batch-mates that are
+        still healthy.  *coll* is the jobs collection the batch was
+        claimed from (passed explicitly: the task's phase may have moved
+        on while these claims are still held)."""
+        if not job_tbls:
+            return []
+        n = self._cnn.connect().update(
+            coll, {"$or": [self._beat_guard(j) for j in job_tbls]},
+            {"$set": {"lease_expires": docstore.now() + self.job_lease}},
+            multi=True)
+        if n >= len(job_tbls):
+            return [True] * len(job_tbls)
+        out = []
+        for j in job_tbls:
+            m = self._cnn.connect().update(
+                coll, self._beat_guard(j),
+                {"$set": {"lease_expires":
+                          docstore.now() + self.job_lease}})
+            out.append(m > 0)
+        return out
 
     def reap_expired(self, coll: str) -> int:
         """Server-side: in-flight jobs (RUNNING, or FINISHED — user fn done

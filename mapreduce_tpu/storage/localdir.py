@@ -15,6 +15,7 @@ import urllib.parse
 import uuid
 from typing import Iterator, List
 
+from ..obs.metrics import storage_io, storage_op
 from .base import Storage
 
 
@@ -54,6 +55,30 @@ class LocalDirStorage(Storage):
     def _read(self, name: str) -> str:
         with open(self._fname(name), "r", encoding="utf-8") as f:
             return f.read()
+
+    # Bytes-through fast path for the blob server: a PUT body lands on
+    # disk and a GET serves the file without a decode+re-encode round
+    # trip through str (two full copies per request for multi-MB map
+    # files).  Blobs are stored utf-8, so these are the same bytes the
+    # str API reads/writes — and they report to the same storage_io
+    # counters the str paths do (base.py wraps _read/_publish; these
+    # bypass those wrappers, so they count here).
+
+    def read_bytes(self, name: str) -> bytes:
+        with open(self._fname(name), "rb") as f:
+            data = f.read()
+        storage_io(self.scheme, "read", len(data))
+        storage_op(self.scheme, "read")
+        return data
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        tmp = os.path.join(self.root, self.STAGING,
+                           f"{os.getpid()}.{uuid.uuid4().hex[:8]}")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.rename(tmp, self._fname(name))  # same fs: atomic
+        storage_io(self.scheme, "write", len(data))
+        storage_op(self.scheme, "publish")
 
     def read_range(self, name: str, start: int, length: int) -> bytes:
         """Bounded-memory byte slice (serves the blob server's Range GETs;

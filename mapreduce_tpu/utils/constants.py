@@ -75,8 +75,14 @@ MAX_PENDING_INSERTS = 50000
 
 #: NEW (no reference equivalent -- fixes the missing dead-worker reaping
 #: called out in SURVEY.md §5): RUNNING jobs whose lease is older than this
-#: are reaped back to BROKEN by the server.
-DEFAULT_JOB_LEASE = 30.0
+#: are reaped back to BROKEN by the server.  Sized against the heartbeat
+#: starvation worst case on a slow-but-alive board: the beat thread shares
+#: its board handle with the main thread's job RPCs AND the claim-ahead
+#: prefetch (an update + a claim), so between successful lease extensions
+#: it can queue behind several full BOARD_DEADLINE (12s) calls — one beat
+#: period + 4 deadlines = 5 + 48 = 53s < 60.  Raise this in step if you
+#: raise --retry-deadline (see utils/httpclient.BOARD_DEADLINE).
+DEFAULT_JOB_LEASE = 60.0
 
 #: worker heartbeat period; must be well under DEFAULT_JOB_LEASE.
 DEFAULT_HEARTBEAT = 5.0
@@ -85,6 +91,15 @@ DEFAULT_HEARTBEAT = 5.0
 #: out for its own cached map jobs and claims anything
 #: (task.lua:249-254 MAX_IDLE_COUNT).
 MAX_IDLE_COUNT = 5
+
+#: NEW (no reference equivalent): jobs a worker claims per board round
+#: trip (claim pipelining, Task.take_next_jobs).  1 restores the
+#: reference's serial claim-per-job traffic; higher amortizes the claim
+#: RPC across the batch and lets the next jobs' claims overlap the
+#: current job's execution.  Kept small so a slow worker doesn't hoard
+#: jobs a free worker could run — each held claim is still individually
+#: lease-fenced, so the failure cost of hoarding is bounded by job_lease.
+DEFAULT_CLAIM_BATCH = 4
 
 #: grid/file-name layout for intermediate files, mirroring the reference's
 #: "<results_ns>.P<part>.M<map_key>" convention (job.lua:196-215).

@@ -79,6 +79,17 @@ _BREAKER = _metrics.counter(
 _BREAKER_FAST_FAIL = _metrics.counter(
     "mrtpu_breaker_fast_fails_total",
     "calls refused while the circuit was open (labels: endpoint)")
+_POOL_IN_FLIGHT = _metrics.gauge(
+    "mrtpu_pool_in_flight",
+    "requests currently executing through a KeepAlivePool "
+    "(labels: endpoint)")
+_POOL_CONNECTIONS = _metrics.gauge(
+    "mrtpu_pool_connections",
+    "sockets a KeepAlivePool has open or idle (labels: endpoint)")
+_POOL_WAITS = _metrics.counter(
+    "mrtpu_pool_waits_total",
+    "requests that had to wait for a pooled connection because every "
+    "slot was in flight (labels: endpoint)")
 
 
 class RetryError(IOError):
@@ -135,13 +146,15 @@ class RetryPolicy:
 
 
 #: board-plane deadline used when RetryPolicy.deadline is None.  Sized
-#: against DEFAULT_JOB_LEASE (30s): a worker's heartbeat shares its
-#: handle lock with job RPCs, so between successful lease extensions the
-#: worst case is one beat period (5s) + a full job-RPC deadline spent
-#: waiting on the lock + the heartbeat's own deadline — 5 + 2*12 = 29s
-#: < 30s.  A bigger value would let a healthy-but-slow board call starve
-#: the heartbeat past the lease and get its own job reaped and fenced;
-#: raise job_lease in step if you raise a deadline past this.
+#: against DEFAULT_JOB_LEASE (60s): a worker's heartbeat shares its
+#: handle lock with job RPCs AND the claim-ahead prefetch (which issues
+#: a task read plus a batched claim), so between successful lease
+#: extensions the worst case is one beat period (5s) + up to three
+#: full-deadline calls queued ahead on the (unfair) handle lock + the
+#: heartbeat's own deadline — 5 + 4*12 = 53s < 60s.  A bigger value
+#: would let a healthy-but-slow board starve the heartbeat past the
+#: lease and get the worker's own jobs reaped and fenced; raise
+#: job_lease in step if you raise a deadline past this.
 BOARD_DEADLINE = 12.0
 
 #: blob-plane deadline used when RetryPolicy.deadline is None: blob
@@ -298,7 +311,8 @@ def check_auth(token: Optional[str], headers) -> bool:
 class KeepAliveClient:
     def __init__(self, host: str, port: int, timeout: float = 60.0,
                  auth_token: Optional[str] = None,
-                 retry: Optional[RetryPolicy] = None) -> None:
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[_Breaker] = None) -> None:
         self.host, self.port, self.timeout = host, port, timeout
         self.retry = retry if retry is not None else DEFAULT_RETRY_POLICY
         if auth_token is not None:
@@ -309,7 +323,11 @@ class KeepAliveClient:
         self._cnn: Optional[http.client.HTTPConnection] = None
         self._lock = threading.Lock()
         self.endpoint = f"{host}:{port}"
-        self._breaker = _Breaker(self.retry, endpoint=self.endpoint)
+        # a KeepAlivePool passes ONE shared breaker so its members agree
+        # on the endpoint's health instead of each needing its own run of
+        # failures to open
+        self._breaker = (breaker if breaker is not None
+                         else _Breaker(self.retry, endpoint=self.endpoint))
 
     @classmethod
     def from_address(cls, address: str, timeout: float = 60.0,
@@ -336,7 +354,18 @@ class KeepAliveClient:
                 body: Optional[bytes] = None,
                 headers: Optional[Dict[str, str]] = None,
                 ) -> Tuple[int, bytes]:
-        """Send one HTTP request under the retry policy.
+        status, _, data = self.request_full(method, path, body=body,
+                                            headers=headers)
+        return status, data
+
+    def request_full(self, method: str, path: str,
+                     body: Optional[bytes] = None,
+                     headers: Optional[Dict[str, str]] = None,
+                     ) -> Tuple[int, Dict[str, str], bytes]:
+        """Send one HTTP request under the retry policy; returns
+        ``(status, response_headers, body)`` — the headers feed the blob
+        plane's gzip negotiation (Content-Encoding / the server's
+        capability advertisement).
 
         Re-sending the identical bytes is what makes N retries no worse
         than one: docstore mutations keep their request id across every
@@ -411,6 +440,7 @@ class KeepAliveClient:
                                       headers=headers)
                     r = self._cnn.getresponse()
                     status, data = r.status, r.read()
+                    resp_headers = dict(r.getheaders())
                 except (http.client.HTTPException, OSError) as exc:
                     self._cnn.close()
                     self._cnn = None
@@ -432,7 +462,7 @@ class KeepAliveClient:
                     # the caller's problem, not request-latency samples
                     _LATENCY.observe(time.monotonic() - t_call,
                                      endpoint=endpoint)
-                return status, data
+                return status, resp_headers, data
             _EXHAUSTED.inc(endpoint=endpoint)
             msg = (f"{method} {path} to {self.host}:{self.port} failed "
                    f"after {policy.max_attempts} attempts / "
@@ -446,3 +476,139 @@ class KeepAliveClient:
             if self._cnn is not None:
                 self._cnn.close()
                 self._cnn = None
+
+
+#: sockets a KeepAlivePool keeps per endpoint.  Sized for the blob
+#: plane's fan-outs (a map job PUTs ~15 partition files, a reduce job
+#: opens every mapper's file): big enough to overlap the wire, small
+#: enough that W workers x POOL sockets stays far under the server's
+#: thread budget.
+DEFAULT_POOL_SIZE = 4
+
+
+class KeepAlivePool:
+    """A small per-endpoint pool of :class:`KeepAliveClient` handles.
+
+    Same ``request``/``request_full`` API as a single client, but up to
+    ``size`` calls proceed CONCURRENTLY — the map phase fans out its
+    per-partition PUTs and the reduce merge keeps several Range-GETs in
+    flight through one pool.  All members share one circuit breaker and
+    one :class:`RetryPolicy`, so the endpoint's health is judged from
+    the pool's combined traffic (a dead endpoint opens the circuit once,
+    not once per socket) and a user's retry tuning governs every member.
+
+    Checkout is LIFO (most-recently-used socket first) so an idle pool
+    decays to one warm keep-alive connection instead of round-robining
+    N cold ones.  When every slot is in flight the caller blocks until
+    one frees — backpressure, not unbounded socket growth.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 auth_token: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 size: int = DEFAULT_POOL_SIZE) -> None:
+        self.host, self.port, self.timeout = host, port, timeout
+        self.retry = retry if retry is not None else DEFAULT_RETRY_POLICY
+        self.size = max(1, int(size))
+        self.endpoint = f"{host}:{port}"
+        if auth_token is not None:
+            self.auth_token: Optional[str] = auth_token or None
+        else:  # same precedence as KeepAliveClient: ambient > env
+            self.auth_token = (ambient_token_for(host, port)
+                               or default_auth_token())
+        self._breaker = _Breaker(self.retry, endpoint=self.endpoint)
+        self._cond = threading.Condition()
+        self._idle: list = []
+        self._created = 0
+        self._in_flight = 0
+        self._closed = False
+
+    @classmethod
+    def from_address(cls, address: str, timeout: float = 60.0,
+                     what: str = "http endpoint",
+                     auth_token: Optional[str] = None,
+                     retry: Optional[RetryPolicy] = None,
+                     size: int = DEFAULT_POOL_SIZE) -> "KeepAlivePool":
+        embedded, address = split_embedded_token(address)
+        if auth_token is None:
+            auth_token = embedded
+        host, _, port = address.partition(":")
+        try:
+            port_n = int(port)
+        except ValueError:
+            port_n = 0
+        if not host or not port or port_n <= 0:
+            raise ValueError(f"{what} wants HOST:PORT, got {address!r}")
+        return cls(host, port_n, timeout, auth_token=auth_token,
+                   retry=retry, size=size)
+
+    def _acquire(self) -> KeepAliveClient:
+        with self._cond:
+            if self._closed:  # checked up front, not just while waiting —
+                # a post-close request must fail, not open a fresh socket
+                raise ConnectionError(
+                    f"KeepAlivePool {self.endpoint} is closed")
+            if not self._idle and self._created >= self.size:
+                _POOL_WAITS.inc(endpoint=self.endpoint)
+            while not self._idle and self._created >= self.size:
+                if self._closed:
+                    raise ConnectionError(
+                        f"KeepAlivePool {self.endpoint} is closed")
+                self._cond.wait()
+            if self._idle:
+                client = self._idle.pop()
+            else:
+                client = KeepAliveClient(
+                    self.host, self.port, self.timeout,
+                    auth_token=self.auth_token or "",
+                    retry=self.retry, breaker=self._breaker)
+                # "" would mean explicitly open; restore the resolved one
+                client.auth_token = self.auth_token
+                self._created += 1
+                _POOL_CONNECTIONS.set(self._created,
+                                      endpoint=self.endpoint)
+            self._in_flight += 1
+            _POOL_IN_FLIGHT.set(self._in_flight, endpoint=self.endpoint)
+            return client
+
+    def _release(self, client: KeepAliveClient) -> None:
+        with self._cond:
+            self._in_flight -= 1
+            _POOL_IN_FLIGHT.set(self._in_flight, endpoint=self.endpoint)
+            if self._closed:
+                client.close()
+                self._created -= 1
+                _POOL_CONNECTIONS.set(self._created,
+                                      endpoint=self.endpoint)
+            else:
+                self._idle.append(client)
+            self._cond.notify()
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None,
+                ) -> Tuple[int, bytes]:
+        status, _, data = self.request_full(method, path, body=body,
+                                            headers=headers)
+        return status, data
+
+    def request_full(self, method: str, path: str,
+                     body: Optional[bytes] = None,
+                     headers: Optional[Dict[str, str]] = None,
+                     ) -> Tuple[int, Dict[str, str], bytes]:
+        client = self._acquire()
+        try:
+            return client.request_full(method, path, body=body,
+                                       headers=headers)
+        finally:
+            self._release(client)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            idle, self._idle = self._idle, []
+            self._created -= len(idle)
+            _POOL_CONNECTIONS.set(self._created, endpoint=self.endpoint)
+            self._cond.notify_all()
+        for c in idle:
+            c.close()

@@ -10,6 +10,7 @@ order (serialization.sort_key).
 
 from __future__ import annotations
 
+import concurrent.futures
 import heapq
 from typing import Any, Callable, Iterable, Iterator, List, Sequence, Tuple
 
@@ -49,10 +50,24 @@ def merge_iterator(
     # the iterator element -- plain heapq is safe (and C-fast); it also makes
     # equal keys concatenate in source order, so the merge is deterministic
     # (the reference's pop order among equal keys is heap-arbitrary).
-    heap: List[tuple] = []
-    for idx, factory in enumerate(sources):
+    def _open(pair):
+        idx, factory = pair
         it = iter(factory())
-        first = next(it, None)
+        return idx, it, next(it, None)
+
+    if len(sources) > 1:
+        # open every source CONCURRENTLY: for http-backed sources the
+        # first next() blocks on a Range-GET, and opening k files one
+        # after another would serialize k round trips before the first
+        # record merges.  Each thread touches a distinct iterator, so
+        # there is no shared state beyond the storage client's pool.
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(len(sources), 8)) as ex:
+            opened = list(ex.map(_open, enumerate(sources)))
+    else:
+        opened = [_open(p) for p in enumerate(sources)]
+    heap: List[tuple] = []
+    for idx, it, first in opened:
         if first is not None:
             key, values = first
             heap.append((sort_key(key), idx, key, list(values), it))

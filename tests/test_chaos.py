@@ -236,6 +236,55 @@ def test_partition_outlasting_lease_fences_old_owner(corpus):
         board.shutdown()
 
 
+# -- (d) pipelined claims + mid-run partition: still exactly-once ----------
+
+
+def test_pipelined_claims_partition_exactly_once(corpus):
+    """Workers claim BATCHES (claim_batch=3, claim-ahead on) while claim
+    RPCs get reset mid-flight AND the board partitions for a window
+    mid-run.  The batched claim rides the same rid-dedupe as the serial
+    one, held-batch leases ride one heartbeat RPC, and the execution-
+    count witness proves every job still ran to completion exactly once
+    and ended WRITTEN."""
+    board = DocServer().start_background()
+    sched = FaultSchedule()
+    rule = sched.reset(match=b"find_and_modify", after=1, count=2)
+    proxy = FaultProxy(board.host, board.port, schedule=sched).start()
+    try:
+        params = _params(corpus)
+        threads = spawn_worker_threads(
+            f"http://{proxy.address}", "ch4", 2,
+            conf={"claim_batch": 3}, retry=CHAOS_RETRY)
+        server = Server(f"http://{board.host}:{board.port}", "ch4",
+                        retry=CHAOS_RETRY)
+        server.configure(params)
+
+        def blip():  # a real partition window once the run is moving
+            time.sleep(0.05)
+            proxy.partition(duration=0.4)
+
+        threading.Thread(target=blip, daemon=True).start()
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=30)
+        assert rule.hits > 0, "no reset ever fired — scenario not exercised"
+        assert chaos_mods.RESULT == naive.wordcount(corpus)
+        assert stats["map"]["failed"] == 0
+        assert stats["reduce"]["failed"] == 0
+        # exactly-once: every map job ran to completion exactly once,
+        # batched claims or not
+        assert dict(chaos_mods.COMPLETED) == {i: 1 for i in
+                                              range(len(corpus))}
+        # and every job document is terminally WRITTEN
+        for coll in (server.task.map_jobs_ns(),
+                     server.task.red_jobs_ns()):
+            for doc in server.cnn.connect().find(coll):
+                assert doc["status"] == int(STATUS.WRITTEN), doc
+    finally:
+        proxy.stop()
+        board.shutdown()
+
+
 # -- dead endpoint: circuit breaker fails fast -----------------------------
 
 

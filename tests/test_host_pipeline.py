@@ -1,0 +1,434 @@
+"""Host data-plane pipelining tests: gzip content negotiation on the
+blob plane (new<->old client/server interop matrix, corrupt-encoding
+rejection), the batched claim RPC (atomicity, rid dedupe, old-server
+fallback), batched heartbeats with per-claim fencing, claim release,
+and the per-endpoint connection pool (concurrency + shared breaker)."""
+
+import http.client
+import gzip
+import json
+import threading
+import time
+import uuid
+
+import pytest
+
+from mapreduce_tpu.coord.connection import Connection
+from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore, _RpcHandler
+from mapreduce_tpu.coord.task import Task, make_job
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.storage.httpstore import BlobServer, HttpStorage
+from mapreduce_tpu.utils.constants import STATUS, TASK_STATUS
+from mapreduce_tpu.utils.httpclient import (
+    CircuitOpenError, KeepAlivePool, RetryPolicy)
+
+
+# -- gzip negotiation matrix ------------------------------------------------
+
+
+@pytest.mark.parametrize("server_gzip,client_gzip", [
+    (True, True),     # new client <-> new server: compressed transfers
+    (True, False),    # old-shaped client -> new server: identity
+    (False, True),    # new client -> old-shaped server: identity
+    (False, False),   # old <-> old
+])
+def test_gzip_negotiation_matrix(tmp_path, server_gzip, client_gzip):
+    """Every combination round-trips the same content; compression only
+    happens when BOTH sides speak it (the client learns from the
+    server's advertisement header), so a new client against an old
+    server degrades to exactly the old wire traffic and vice versa."""
+    srv = BlobServer(str(tmp_path / "b"),
+                     gzip_enabled=server_gzip).start_background()
+    try:
+        st = HttpStorage(srv.address, compress=client_gzip)
+        payload = "the quick brown fox line\n" * 400  # >> GZIP_MIN_BYTES
+        wire0 = REGISTRY.value("mrtpu_blob_wire_bytes_total",
+                               direction="put", encoding="gzip")
+        st.write("probe", payload)    # first PUT: identity (negotiation)
+        st.write("blob", payload)     # second: gzip iff negotiated
+        assert st.read("blob") == payload
+        assert st.read("probe") == payload
+        assert list(st.open_lines("blob")) == (
+            ["the quick brown fox line"] * 400)
+        assert sorted(st.list()) == ["blob", "probe"]
+        assert st.exists("blob")
+        # the bytes on disk are the RAW text in every combination — the
+        # server decodes before publishing, never stores wire encoding
+        assert (tmp_path / "b" / "blob").read_text() == payload
+        wire1 = REGISTRY.value("mrtpu_blob_wire_bytes_total",
+                               direction="put", encoding="gzip")
+        negotiated = server_gzip and client_gzip
+        assert st._server_gzip is server_gzip or not client_gzip
+        if negotiated:
+            put_wire = wire1 - wire0
+            assert 0 < put_wire < len(payload) / 3, (
+                "second PUT should have moved gzipped bytes")
+        else:
+            assert wire1 == wire0, "no gzip PUT may happen un-negotiated"
+    finally:
+        srv.shutdown()
+
+
+def test_gzip_corrupt_encoding_rejected(tmp_path):
+    """A PUT declaring Content-Encoding: gzip with a garbage body must be
+    refused (400) and publish nothing — storing it would poison every
+    reader of the blob."""
+    srv = BlobServer(str(tmp_path / "b")).start_background()
+    try:
+        cnn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+        cnn.request("PUT", "/blobs/bad", body=b"\x1f\x8bNOT-GZIP-AT-ALL",
+                    headers={"Content-Encoding": "gzip"})
+        assert cnn.getresponse().status == 400
+        cnn.close()
+        st = HttpStorage(srv.address)
+        assert not st.exists("bad")
+        # a VALID gzip body through the raw path publishes the raw text
+        cnn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+        cnn.request("PUT", "/blobs/good", body=gzip.compress(b"hello\n"),
+                    headers={"Content-Encoding": "gzip"})
+        assert cnn.getresponse().status == 201
+        cnn.close()
+        assert st.read("good") == "hello\n"
+    finally:
+        srv.shutdown()
+
+
+def test_gzip_server_downgrade_heals_via_415(tmp_path):
+    """A client that negotiated gzip against a server later restarted
+    with --no-gzip must not poison blobs: the downgraded server refuses
+    the encoded PUT (415), the client forgets the advert and re-sends
+    identity — the blob publishes with the RAW text."""
+    root = str(tmp_path / "b")
+    srv = BlobServer(root).start_background()
+    st = HttpStorage(srv.address)
+    payload = "downgrade survival line\n" * 200
+    st.write("probe", payload)
+    assert st._server_gzip is True
+    srv.shutdown()
+    # restart WITHOUT gzip; a fresh handle with the STALE gzip belief
+    # models the long-lived client that negotiated before the restart
+    srv2 = BlobServer(root, gzip_enabled=False).start_background()
+    st2 = HttpStorage(srv2.address)
+    st2._server_gzip = True
+    st2.write("after", payload)  # gzipped PUT -> 415 -> identity retry
+    assert st2._server_gzip is False
+    assert st2.read("after") == payload
+    assert (tmp_path / "b" / "after").read_text() == payload
+    srv2.shutdown()
+
+
+def test_pool_refuses_requests_after_close(tmp_path):
+    srv = BlobServer(str(tmp_path / "b")).start_background()
+    try:
+        pool = KeepAlivePool(srv.host, srv.port)
+        status, _ = pool.request("GET", "/list")
+        assert status == 200
+        pool.close()
+        with pytest.raises(ConnectionError):
+            pool.request("GET", "/list")
+    finally:
+        srv.shutdown()
+
+
+def test_range_gets_stay_identity(tmp_path):
+    """Range-GET offsets address the STORED bytes: slices come back raw
+    even from a gzip-negotiated pair, so the streaming line reader's
+    arithmetic is encoding-independent."""
+    srv = BlobServer(str(tmp_path / "b")).start_background()
+    try:
+        st = HttpStorage(srv.address)
+        lines = [f"line {i} padded out to be longer" for i in range(2000)]
+        st.write("probe", "x")                      # learn the advert
+        st.write("big", "\n".join(lines) + "\n")    # gzipped PUT
+        assert st._server_gzip
+        st.LINES_CHUNK = 4096
+        assert list(st.open_lines("big")) == lines
+    finally:
+        srv.shutdown()
+
+
+# -- batched claims ---------------------------------------------------------
+
+
+@pytest.fixture(params=["mem", "http"])
+def connstr(request):
+    if request.param == "mem":
+        yield f"mem://{uuid.uuid4().hex}"
+    else:
+        srv = DocServer().start_background()
+        yield srv.connstr
+        srv.shutdown()
+
+
+def _mk_task(connstr, status=TASK_STATUS.MAP, lease=30.0):
+    cnn = Connection(connstr, "db")
+    task = Task(cnn, job_lease=lease)
+    task.create_collection(status, {
+        "taskfn": "m", "mapfn": "m", "partitionfn": "m", "reducefn": "m",
+        "finalfn": "m", "storage": "mem:x", "path": "x",
+    }, iteration=1)
+    return cnn, task
+
+
+def test_take_next_jobs_claims_batch_atomically(connstr):
+    cnn, task = _mk_task(connstr)
+    task.insert_jobs(task.map_jobs_ns(),
+                     [make_job(i, f"f{i}") for i in range(5)])
+    jobs, st = task.take_next_jobs("w1", "tmp1", 3)
+    assert st == TASK_STATUS.MAP
+    assert len(jobs) == 3
+    assert {j["worker"] for j in jobs} == {"w1"}
+    assert all(j["status"] == int(STATUS.RUNNING) for j in jobs)
+    assert len({j["_id"] for j in jobs}) == 3
+    # the remainder is claimable by someone else; over-asking caps at
+    # what exists
+    jobs2, _ = task.take_next_jobs("w2", "tmp2", 10)
+    assert len(jobs2) == 2
+    jobs3, _ = task.take_next_jobs("w3", "tmp3", 4)
+    assert jobs3 == []
+
+
+def test_heartbeat_many_fences_only_the_lost_claim(connstr):
+    """One batched beat covers every held lease; when one claim has been
+    clobbered (re-issued to another worker) exactly that claim reports
+    lost — its batch-mates keep their leases."""
+    cnn, task = _mk_task(connstr, lease=30.0)
+    task.insert_jobs(task.map_jobs_ns(),
+                     [make_job(i, f"f{i}") for i in range(3)])
+    jobs, _ = task.take_next_jobs("w1", "t1", 3)
+    coll = task.map_jobs_ns()
+    owned = task.heartbeat_many(coll, jobs)
+    assert owned == [True, True, True]
+    old_leases = {d["_id"]: d["lease_expires"]
+                  for d in cnn.connect().find(coll)}
+    # steal the middle claim (what a reap + reclaim does)
+    cnn.connect().update(coll, {"_id": jobs[1]["_id"]},
+                         {"$set": {"worker": "thief", "tmpname": "zz"}})
+    time.sleep(0.01)
+    owned = task.heartbeat_many(coll, jobs)
+    assert owned == [True, False, True]
+    docs = {d["_id"]: d for d in cnn.connect().find(coll)}
+    for j in (jobs[0], jobs[2]):  # survivors' leases were extended
+        assert docs[j["_id"]]["lease_expires"] > old_leases[j["_id"]]
+
+
+def test_release_jobs_returns_claims_without_repetitions(connstr):
+    cnn, task = _mk_task(connstr)
+    task.insert_jobs(task.map_jobs_ns(),
+                     [make_job(i, f"f{i}") for i in range(3)])
+    jobs, _ = task.take_next_jobs("w1", "t1", 3)
+    coll = task.map_jobs_ns()
+    n = task.release_jobs(coll, jobs[1:])
+    assert n == 2
+    docs = {d["_id"]: d for d in cnn.connect().find(coll)}
+    assert docs[jobs[0]["_id"]]["status"] == int(STATUS.RUNNING)
+    for j in jobs[1:]:
+        d = docs[j["_id"]]
+        assert d["status"] == int(STATUS.WAITING)
+        assert d["repetitions"] == 0  # a release is not a failure
+    # and released jobs are immediately claimable
+    again, _ = task.take_next_jobs("w2", "t2", 3)
+    assert len(again) == 2
+
+
+def test_batched_claim_rid_dedupe():
+    """A retried find_and_modify_many (same rid) replays the recorded
+    batch instead of claiming a second batch."""
+    srv = DocServer().start_background()
+    try:
+        for i in range(6):
+            srv.store.insert("c", {"_id": str(i), "status": 0})
+        payload = {"op": "find_and_modify_many", "coll": "c",
+                   "query": {"status": 0},
+                   "update": {"$set": {"status": 1}},
+                   "limit": 3, "rid": "sess:1"}
+
+        def post():
+            cnn = http.client.HTTPConnection(srv.host, srv.port,
+                                             timeout=10)
+            cnn.request("POST", "/rpc", body=json.dumps(payload).encode())
+            out = json.loads(cnn.getresponse().read())
+            cnn.close()
+            return out
+
+        first, again = post(), post()
+        assert first["ok"] and again["ok"]
+        assert first["result"] == again["result"]
+        assert len(first["result"]) == 3
+        assert srv.store.count("c", {"status": 1}) == 3  # not 6
+    finally:
+        srv.shutdown()
+
+
+def test_batched_claim_falls_back_on_old_server(monkeypatch):
+    """Against a server predating find_and_modify_many the client speaks
+    the old dialect: serial claims, same results."""
+    srv = DocServer().start_background()
+    orig = _RpcHandler._execute
+
+    def no_batch(self, op, req):
+        if op == "find_and_modify_many":
+            raise ValueError(f"unknown rpc op {op!r}")
+        return orig(self, op, req)
+
+    monkeypatch.setattr(_RpcHandler, "_execute", no_batch)
+    try:
+        for i in range(4):
+            srv.store.insert("c", {"_id": str(i), "status": 0})
+        client = HttpDocStore(f"{srv.host}:{srv.port}")
+        got = client.find_and_modify_many("c", {"status": 0},
+                                          {"$set": {"status": 1}}, 3)
+        assert len(got) == 3
+        assert client._no_batched_claims
+        # subsequent calls keep working (and keep the old dialect)
+        got2 = client.find_and_modify_many("c", {"status": 0},
+                                           {"$set": {"status": 1}}, 3)
+        assert len(got2) == 1
+        client.close()
+    finally:
+        srv.shutdown()
+
+
+# -- connection pool --------------------------------------------------------
+
+
+def test_pool_overlaps_requests(tmp_path):
+    """K requests through one pool proceed concurrently: with a server
+    that sleeps per request, K concurrent calls complete in ~1 sleep,
+    not K."""
+    srv = BlobServer(str(tmp_path / "b")).start_background()
+    try:
+        st = HttpStorage(srv.address, pool_size=4)
+        for i in range(4):
+            st.write(f"f{i}", f"content {i}\n" * 10)
+        results = {}
+
+        def read(i):
+            results[i] = st.read(f"f{i}")
+
+        threads = [threading.Thread(target=read, args=(i,))
+                   for i in range(4)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert time.monotonic() - t0 < 10
+        assert results == {i: f"content {i}\n" * 10 for i in range(4)}
+    finally:
+        srv.shutdown()
+
+
+def test_pool_members_share_one_breaker():
+    """Transport failures on DIFFERENT pooled sockets accumulate into
+    ONE breaker: two failures on two members open the circuit for the
+    whole endpoint."""
+    pol = RetryPolicy(max_attempts=1, deadline=0.3,
+                      breaker_threshold=2, breaker_cooldown=60)
+    pool = KeepAlivePool("127.0.0.1", 1, retry=pol, size=2)
+    a = pool._acquire()
+    b = pool._acquire()
+    assert a is not b
+    for member in (a, b):  # one transport failure per member
+        with pytest.raises(OSError):
+            member.request("GET", "/")
+    pool._release(a)
+    pool._release(b)
+    with pytest.raises(CircuitOpenError):
+        pool.request("GET", "/")
+    pool.close()
+
+
+def test_prefetched_claims_stay_leased_during_long_job(tmp_path):
+    """A claim-ahead batch is under heartbeat coverage from the moment
+    the claim RPC answers — NOT from when the current job finishes.  A
+    job running longer than the lease must not let the prefetched
+    claim expire and be reaped (which would charge spurious
+    repetitions toward FAILED)."""
+    import threading as th
+
+    from mapreduce_tpu import spec
+    from mapreduce_tpu.examples import naive
+    from mapreduce_tpu.server import Server
+    from mapreduce_tpu.worker import Worker
+    from tests import chaos_mods
+
+    spec.clear_caches()
+    files = []
+    for i in range(3):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(f"leases alpha f{i}\n" * 3)
+        files.append(str(p))
+    chaos_mods.reset(files, hold_key=0)  # job 0 blocks until released
+    connstr = f"mem://{uuid.uuid4().hex}"
+    params = {r: "tests.chaos_mods" for r in
+              ("taskfn", "mapfn", "partitionfn", "reducefn", "finalfn")}
+    params["storage"] = f"mem:{uuid.uuid4().hex}"
+    server = Server(connstr, "lease1", job_lease=0.5)
+    server.configure(params)
+    w = Worker(connstr, "lease1", name="w-long")
+    w.claim_batch = 1  # every job is "last queued": prefetch fires each run
+    w.heartbeat_period = 0.1
+    w.task.job_lease = 0.5
+    stats = {}
+    wt = th.Thread(target=w.execute, daemon=True)
+    st = th.Thread(target=lambda: stats.update(server.loop()),
+                   daemon=True)
+    wt.start()
+    st.start()
+    give_up = time.monotonic() + 10
+    while chaos_mods.STARTED[0] != 1 and time.monotonic() < give_up:
+        time.sleep(0.02)
+    assert chaos_mods.STARTED[0] == 1, "worker never started the held job"
+    # hold job 0 across several lease periods while the server's reaper
+    # runs; the prefetched claim must survive on heartbeats alone
+    time.sleep(1.5)
+    chaos_mods.HOLD.set()
+    st.join(timeout=30)
+    wt.join(timeout=30)
+    assert stats and stats["map"]["failed"] == 0
+    assert dict(chaos_mods.COMPLETED) == {0: 1, 1: 1, 2: 1}
+    assert chaos_mods.RESULT == naive.wordcount(files)
+    for doc in server.cnn.connect().find(server.task.map_jobs_ns()):
+        assert doc["repetitions"] == 0, (
+            f"job {doc['_id']} was lease-reaped while prefetched: {doc}")
+    spec.clear_caches()
+
+
+# -- pipelined end-to-end ---------------------------------------------------
+
+
+def test_wordcount_exact_with_claim_pipelining(tmp_path):
+    """A full map->reduce->final cycle with batched claims + claim-ahead
+    on: the exactly-once witness (chaos_mods COMPLETED) holds and the
+    result is exact — pipelining must not change semantics even with
+    more jobs than workers."""
+    from mapreduce_tpu import spec
+    from mapreduce_tpu.examples import naive
+    from mapreduce_tpu.server import Server
+    from mapreduce_tpu.worker import spawn_worker_threads
+    from tests import chaos_mods
+
+    spec.clear_caches()
+    files = []
+    for i in range(7):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(f"pipeline words w{i % 3} alpha beta\n" * 4)
+        files.append(str(p))
+    chaos_mods.reset(files)
+    connstr = f"mem://{uuid.uuid4().hex}"
+    params = {r: "tests.chaos_mods" for r in
+              ("taskfn", "mapfn", "partitionfn", "reducefn", "finalfn")}
+    params["storage"] = f"mem:{uuid.uuid4().hex}"
+    threads = spawn_worker_threads(connstr, "pipe", 2,
+                                   conf={"claim_batch": 3})
+    server = Server(connstr, "pipe")
+    server.configure(params)
+    stats = server.loop()
+    for t in threads:
+        t.join(timeout=30)
+    assert chaos_mods.RESULT == naive.wordcount(files)
+    assert stats["map"]["failed"] == 0
+    assert stats["reduce"]["failed"] == 0
+    assert dict(chaos_mods.COMPLETED) == {i: 1 for i in range(len(files))}
+    spec.clear_caches()
