@@ -10,6 +10,22 @@ engine on whatever accelerator is present and prints ONE JSON line:
     {"metric": "europarl_wordcount_wall_s", "value": <seconds>,
      "unit": "s", "vs_baseline": <47.372 / seconds>}
 
+Flags:
+
+* ``--smoke`` — 1/500-scale quick self-check of the bench itself;
+* ``--check`` — REGRESSION GATE: after the run, compare against the
+  recorded ``BENCH.json`` history (per-metric tolerances, median
+  baseline — obs/benchgate.py), exit nonzero on regression, append the
+  accepted run to the history;
+* ``--check --smoke`` — the tier-1-safe gate self-check: exercises the
+  gate against the committed history with SYNTHETIC entries derived
+  from the history itself (median must pass, an injected 2x slowdown
+  must fail) plus a tiny CPU-sized device run asserted purely from the
+  metrics registry — no wall-clock comparisons, cannot flake on load;
+* ``--profile DIR`` — capture a profile bundle (Chrome trace +
+  /metrics + statusz device section + ``jax.profiler`` trace when the
+  backend supports it) of the timed runs into DIR.
+
 Clock semantics match the reference's: its 47.372s times map+reduce with
 the Europarl splits ALREADY in cluster storage (taskfn emits file paths;
 the corpus was split and loaded before the benchmark,
@@ -39,6 +55,26 @@ import numpy as np
 BASELINE_S = 47.372          # reference README.md:70, 4 workers
 N_WORDS = 49_158_635         # reference README.md:43-45
 N_LINES = 1_965_734
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+#: the enforced perf trajectory (obs/benchgate.py): --check compares
+#: against this history and appends accepted runs
+HISTORY_PATH = os.path.join(REPO, "BENCH.json")
+
+
+def gate_specs():
+    """Per-metric tolerances for --check, sized to this fixture's
+    measured variance: compute_s is stable (±5% across the recorded
+    history), the best-of-N wall value swings more (readback rides the
+    tunnelled link), materialize depends on host load."""
+    from mapreduce_tpu.obs.benchgate import MetricSpec
+
+    return [
+        MetricSpec("value", rel_tol=0.50, required=True),
+        MetricSpec("timings.compute_s", rel_tol=0.35),
+        MetricSpec("timings.readback_s", rel_tol=1.00),
+        MetricSpec("timings.materialize_s", rel_tol=1.50),
+    ]
 VOCAB = 80_000
 N_PUNCT_VOCAB = 10_000       # vocab entries that are word+punctuation
 N_LONG = 5                   # distinct >128-byte tokens (tail words)
@@ -115,10 +151,76 @@ def make_corpus(n_words: int = N_WORDS, n_lines: int = N_LINES,
     return out.tobytes() + bytes(tail)
 
 
+def check_smoke() -> int:
+    """``--check --smoke``: the tier-1-safe regression-gate self-check.
+    No accelerator requirement and ZERO wall-clock comparisons (so it
+    cannot flake on a loaded CI host):
+
+    1. gate logic against the COMMITTED history — synthetic entries are
+       derived from the history itself (obs/benchgate.synthetic_entry):
+       the medians must pass, an injected 2x slowdown must be flagged;
+    2. a tiny CPU-sized device-engine wordcount, judged purely from the
+       obs registry: waves ran, the cost model recorded FLOPs (analytic
+       fallback included), the MFU gauge landed.
+    """
+    from mapreduce_tpu.obs import benchgate
+    from mapreduce_tpu.obs.metrics import REGISTRY
+    from mapreduce_tpu.obs.profile import analytic_costs
+
+    specs = gate_specs()
+    _, history = benchgate.load_history(HISTORY_PATH)
+    assert history, f"no committed history in {HISTORY_PATH}"
+    ok_probs = benchgate.gate(
+        benchgate.synthetic_entry(history, specs), history, specs)
+    assert not ok_probs, (
+        f"gate flagged the history's own medians: {ok_probs}")
+    bad_probs = benchgate.gate(
+        benchgate.synthetic_entry(history, specs, scale=2.0),
+        history, specs)
+    assert bad_probs, "gate did not flag a 2x synthetic slowdown"
+
+    # analytic fallback must produce usable numbers on its own (it is
+    # the only cost path on backends without cost_analysis)
+    est = analytic_costs(1 << 20, 1 << 15, 16)
+    assert est["flops"] > 0 and est["bytes"] > 0, est
+
+    from mapreduce_tpu.engine import DeviceWordCount
+    from mapreduce_tpu.engine.device_engine import EngineConfig
+    from mapreduce_tpu.parallel import make_mesh
+
+    wc = DeviceWordCount(
+        make_mesh(), chunk_len=4096,
+        config=EngineConfig(local_capacity=4096, exchange_capacity=2048,
+                            out_capacity=4096, tile=512, tile_records=64))
+    corpus = b"gate smoke alpha beta gamma delta " * 500
+    f0 = REGISTRY.sum("mrtpu_device_flops_total")
+    w0 = REGISTRY.value("mrtpu_device_waves_total")
+    counts = wc.count_bytes(corpus)
+    assert counts[b"alpha"] == 500, counts.get(b"alpha")
+    assert REGISTRY.value("mrtpu_device_waves_total") > w0
+    flops = REGISTRY.sum("mrtpu_device_flops_total") - f0
+    assert flops > 0, "device run recorded no FLOPs (cost model broken)"
+
+    print(json.dumps({
+        "mode": "check_smoke", "ok": True,
+        "history_runs": len(history),
+        "gate_flagged_2x": bad_probs,
+        "device_flops_recorded": flops,
+        "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
+    }, default=float))
+    return 0
+
+
 def main() -> None:
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     if "--smoke" in sys.argv:  # quick self-check mode
         scale = 0.002
+    prof_dir = None
+    for i, a in enumerate(sys.argv):
+        if a == "--profile":
+            if i + 1 >= len(sys.argv):
+                sys.exit("--profile needs a bundle directory argument")
+            prof_dir = sys.argv[i + 1]
 
     # persistent XLA compilation cache: cold compile is ~100s at bench
     # shapes (the lax.sort comparator — analysis with numbers in
@@ -191,6 +293,19 @@ def main() -> None:
     print(f"# warmup done in {compile_s:.1f}s (AOT {aot_s:.1f}s, "
           "priming on a two-wave slice)", file=sys.stderr, flush=True)
 
+    # optional jax.profiler capture around the timed runs (rides the
+    # --profile bundle; not every backend supports tracing — degrade to
+    # a bundle without the jax trace, never fail the bench over it)
+    jax_trace_dir = None
+    if prof_dir:
+        jax_trace_dir = os.path.join(prof_dir, "jax_trace")
+        try:
+            jax.profiler.start_trace(jax_trace_dir)
+        except Exception as exc:
+            print(f"# jax.profiler unavailable ({exc}); bundle will "
+                  "carry no jax trace", file=sys.stderr)
+            jax_trace_dir = None
+
     # best of N timed runs: the tunnelled link's bandwidth also swings
     # >10x with ambient load (per-run stages go to stderr so the
     # variance stays visible)
@@ -212,6 +327,8 @@ def main() -> None:
         print(f"# run{r}: {json.dumps(tm)}", file=sys.stderr, flush=True)
     best = min(runs, key=lambda tm: tm["wall_s"])
     wall = best["wall_s"]
+    if jax_trace_dir:
+        jax.profiler.stop_trace()
 
     total = sum(counts.values())
     assert total == int(N_WORDS * scale), total
@@ -255,6 +372,12 @@ def main() -> None:
                         "value, matching the reference clock (its corpus "
                         "pre-exists in cluster storage).",
         "timings": {k: v for k, v in best.items() if k != "wall_s"},
+        # system-computed MFU/roofline (obs/profile.py — no longer an
+        # ad-hoc bench-script derivation): XLA cost_analysis flops over
+        # the best run's compute seconds against the device peak table
+        "mfu": best.get("mfu"),
+        "roofline_frac": best.get("roofline_frac"),
+        "cost_source": best.get("cost_source"),
     }
     print(json.dumps(result))
     print(f"# {len(counts)} unique words, {total} total; "
@@ -262,6 +385,29 @@ def main() -> None:
           f"platform={jax.devices()[0].platform}; corpus gen {gen_s:.1f}s",
           file=sys.stderr)
 
+    if prof_dir:
+        from mapreduce_tpu.obs import profile as obs_profile
+
+        obs_profile.write_bundle(prof_dir, jax_trace_dir=jax_trace_dir)
+        print(f"# profile bundle -> {prof_dir} (trace.json opens in "
+              "https://ui.perfetto.dev)", file=sys.stderr)
+
+    if "--check" in sys.argv:
+        from mapreduce_tpu.obs import benchgate
+
+        problems = benchgate.check_and_append(HISTORY_PATH, result,
+                                              gate_specs())
+        if problems:
+            print("REGRESSION GATE FAILED vs BENCH.json history:",
+                  file=sys.stderr)
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+            sys.exit(1)
+        print(f"# gate OK; run appended to {HISTORY_PATH}",
+              file=sys.stderr)
+
 
 if __name__ == "__main__":
+    if "--check" in sys.argv and "--smoke" in sys.argv:
+        raise SystemExit(check_smoke())
     main()

@@ -29,6 +29,15 @@ the metrics registry that board claim RPCs per job dropped.  No
 wall-clock comparisons, so it cannot flake on load.  Both modes merge
 their result into BENCH_HOST.json ("after" / "smoke" keys; "before"
 holds the pre-pipelining measurement).
+
+``--check`` adds the REGRESSION GATE (obs/benchgate.py): the run is
+compared against BENCH_HOST.json's recorded history — full mode gates
+wall seconds against the "history" list, smoke mode gates the
+registry-derived efficiency metrics (claim RPCs per job, blob wire
+bytes — deterministic-ish counts, still no wall clock) against
+"smoke_history" — exiting nonzero on regression and appending accepted
+runs, so the bench files are an enforced perf trajectory rather than
+write-only artifacts.
 """
 
 from __future__ import annotations
@@ -44,6 +53,52 @@ BASELINE_4W_S = 47.372       # reference README.md:70 (4 workers)
 BASELINE_1W_S = 146.53       # reference README.md:77
 BASELINE_30W_S = 32.0        # reference README.md:79
 REPO = os.path.dirname(os.path.abspath(__file__))
+HISTORY_PATH = os.path.join(REPO, "BENCH_HOST.json")
+
+
+def _smoke_gate_specs():
+    """--check --smoke tolerances: registry counts, not wall clock.
+    Absolute claim-RPC counts are machine-dependent (idle polls scale
+    with host speed), so the gated form is the pipelined/serial RATIO —
+    self-normalizing, and a disabled claim pipeline drives it to ~1.0
+    which any sane tolerance flags.  Gzip'd wire bytes are
+    near-deterministic (tighter band)."""
+    from mapreduce_tpu.obs.benchgate import MetricSpec
+
+    return [
+        MetricSpec("claim_ratio", rel_tol=0.50, required=True),
+        MetricSpec("pipelined.blob_wire_bytes", rel_tol=0.35),
+    ]
+
+
+def _full_gate_specs():
+    """--check tolerances for the full timed run: this one-core fixture
+    time-slices all workers, so wall seconds get a wide band."""
+    from mapreduce_tpu.obs.benchgate import MetricSpec
+
+    return [
+        MetricSpec("value", rel_tol=0.50, required=True),
+        MetricSpec("phase_stats.map_cluster_s", rel_tol=0.75),
+        MetricSpec("phase_stats.reduce_cluster_s", rel_tol=0.75),
+    ]
+
+
+def _run_gate(current, specs, key) -> int:
+    """Gate *current* against HISTORY_PATH[key]; append on pass.
+    Returns the process exit code."""
+    from mapreduce_tpu.obs import benchgate
+
+    problems = benchgate.check_and_append(HISTORY_PATH, current, specs,
+                                          key=key)
+    if problems:
+        print(f"REGRESSION GATE FAILED vs BENCH_HOST.json[{key!r}]:",
+              file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(f"# gate OK; run appended to {HISTORY_PATH}[{key!r}]",
+          file=sys.stderr)
+    return 0
 
 
 def _merge_bench_json(key: str, payload: dict) -> str:
@@ -137,7 +192,11 @@ def smoke() -> int:
                  compress=False)
     pipelined = run(None, compress=True)
     result = {"mode": "smoke", "workers": workers,
-              "serial": serial, "pipelined": pipelined}
+              "serial": serial, "pipelined": pipelined,
+              # board round trips per job, pipelined over serial — the
+              # machine-speed-normalized form the --check gate uses
+              "claim_ratio": round(pipelined["claim_rpcs_per_job"]
+                                   / serial["claim_rpcs_per_job"], 4)}
     assert (pipelined["claim_rpcs_per_job"]
             < serial["claim_rpcs_per_job"]), (
         "pipelined claim path did not reduce board round trips per job: "
@@ -152,6 +211,8 @@ def smoke() -> int:
           f"{serial['blob_wire_bytes']:.0f} -> "
           f"{pipelined['blob_wire_bytes']:.0f}", file=sys.stderr)
     shutil.rmtree(corpus_dir, ignore_errors=True)
+    if "--check" in sys.argv:
+        return _run_gate(result, _smoke_gate_specs(), key="smoke_history")
     return 0
 
 
@@ -295,6 +356,8 @@ def main() -> None:
     }
     _merge_bench_json("after", result)
     print(json.dumps(result, default=float))
+    if "--check" in sys.argv:
+        sys.exit(_run_gate(result, _full_gate_specs(), key="history"))
 
 
 if __name__ == "__main__":

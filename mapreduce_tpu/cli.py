@@ -13,6 +13,10 @@ strips ``.lua``):
       wrapper over the WordCount example / device engine.
   python -m mapreduce_tpu.cli status CONNSTR [--watch S] — live cluster
       view polled from the docserver's /statusz endpoint.
+  python -m mapreduce_tpu.cli profile CONNSTR --out DIR — capture a
+      self-contained profile bundle (Chrome trace + /metrics + /statusz)
+      from a live docserver; bench.py --profile DIR does the same for a
+      single bench run.
 
 CONNSTR is ``mem://NAME`` (single process), ``dir:///PATH`` (shared
 directory: OS processes on one host / NFS), or ``http://HOST:PORT``
@@ -94,7 +98,25 @@ def _add_trace(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="on exit, write this process's spans as Chrome "
                         "trace-event JSON (load in Perfetto / "
-                        "chrome://tracing)")
+                        "chrome://tracing).  The span buffer is a "
+                        "bounded ring of --trace-max-events spans: "
+                        "overflow evicts the OLDEST spans (the export "
+                        "keeps the newest activity) and counts each "
+                        "eviction in mrtpu_trace_dropped_total")
+    p.add_argument("--trace-max-events", type=int, default=None,
+                   metavar="N",
+                   help="span ring capacity (default: 100000; long "
+                        "soaks wanting the full timeline should raise "
+                        "it — ~1KB of export per span)")
+
+
+def _setup_trace(args) -> None:
+    """Apply trace flags BEFORE any span records (the ring bound must
+    hold from the first span, not from export time)."""
+    if getattr(args, "trace_max_events", None):
+        from .obs.trace import TRACER
+
+        TRACER.max_events = max(1, args.trace_max_events)
 
 
 def _export_trace(args) -> None:
@@ -133,6 +155,7 @@ def cmd_server(argv: List[str]) -> int:
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
+    _setup_trace(args)
 
     from .server import Server
 
@@ -182,6 +205,7 @@ def cmd_worker(argv: List[str]) -> int:
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
+    _setup_trace(args)
 
     from .worker import Worker, spawn_worker_threads
 
@@ -219,6 +243,7 @@ def cmd_wordcount(argv: List[str]) -> int:
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose)
+    _setup_trace(args)
 
     import uuid
 
@@ -376,13 +401,40 @@ def cmd_drop(argv: List[str]) -> int:
     return 0
 
 
+def _render_device(dev: dict) -> List[str]:
+    """The device-plane section of a /statusz snapshot (zero when the
+    serving process never ran the engine — the engine's numbers live in
+    the server/bench process, README scope caveat)."""
+    if not dev or not (dev.get("flops_total") or dev.get("waves")):
+        return []
+    secs = dev.get("seconds", {})
+    lines = ["device plane ({} waves, {} retries):".format(
+        dev.get("waves", 0), dev.get("retries", 0))]
+    lines.append(
+        "  upload {:.2f}s  compute {:.2f}s  readback {:.2f}s | "
+        "{:.3g} GFLOP, {:.3g} GB accessed".format(
+            secs.get("upload", 0.0), secs.get("compute", 0.0),
+            secs.get("readback", 0.0),
+            dev.get("flops_total", 0.0) / 1e9,
+            dev.get("bytes_total", 0.0) / 1e9))
+    if dev.get("mfu"):
+        lines.append(
+            "  MFU {:.4%}  roofline {:.2%}  ({:.3g} FLOP/s achieved, "
+            "{:.2f} flops/byte)".format(
+                dev.get("mfu", 0.0), dev.get("roofline_frac", 0.0),
+                dev.get("model_flops_per_s", 0.0),
+                dev.get("arith_intensity", 0.0)))
+    return lines
+
+
 def render_status(snap: dict) -> str:
     """One-screen text view of a /statusz snapshot (the master status
     page role, Dean & Ghemawat §4.6)."""
-    lines: List[str] = []
+    lines: List[str] = _render_device(snap.get("device") or {})
     tasks = snap.get("tasks", {})
     if not tasks:
-        return "no tasks on this board\n"
+        lines.append("no tasks on this board")
+        return "\n".join(lines) + "\n"
     for db, t in sorted(tasks.items()):
         lines.append(f"[{db}]  status={t.get('status')}  "
                      f"iteration={t.get('iteration')}"
@@ -425,6 +477,19 @@ def render_status(snap: dict) -> str:
                     r.get("sum_cpu_time", 0.0),
                     stats.get("cluster_time", 0.0),
                     stats.get("iteration", 0)))
+            d = stats.get("device")
+            if d:
+                # per-task engine timings travel in the persisted stats
+                # doc, so they render even when the statusz-serving
+                # process is not the one that ran the engine
+                mfu = ("  MFU {:.4%}".format(d["mfu"])
+                       if d.get("mfu") else "")
+                lines.append(
+                    "  device: {} waves  upload {:.2f}s  compute "
+                    "{:.2f}s  readback {:.2f}s{}".format(
+                        d.get("waves", 0), d.get("upload_s", 0.0),
+                        d.get("compute_s", 0.0),
+                        d.get("readback_s", 0.0), mfu))
     return "\n".join(lines) + "\n"
 
 
@@ -502,6 +567,73 @@ def cmd_status(argv: List[str]) -> int:
         store.close()
 
 
+def cmd_profile(argv: List[str]) -> int:
+    """Capture a self-contained profile bundle from a LIVE cluster: the
+    docserver's /metrics exposition, /statusz cluster snapshot and
+    /tracez span ring land in one directory (manifest + metrics.prom +
+    statusz.json + trace.json) that obs.profile.load_bundle re-validates
+    and Perfetto/Prometheus load directly.  For a single bench run use
+    ``bench.py --profile DIR`` — same bundle, captured in-process where
+    the engine's spans and FLOPs counters live."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu profile")
+    p.add_argument("connstr",
+                   help="the docserver, http://HOST:PORT "
+                        "(the same CONNSTR workers use)")
+    p.add_argument("--out", required=True, metavar="DIR",
+                   help="bundle directory (created if missing)")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    from .coord.docserver import HttpDocStore
+    from .obs import profile as obs_profile
+
+    connstr = args.connstr
+    if connstr.startswith("http://"):
+        connstr = connstr[len("http://"):]
+    connstr = connstr.split("/", 1)[0]
+    try:
+        store = HttpDocStore(connstr, auth_token=args.auth)
+    except ValueError:
+        print(f"profile wants a docserver address (http://HOST:PORT), "
+              f"got {args.connstr!r}", file=sys.stderr)
+        return 2
+    try:
+        metrics_text = store.metrics_text()
+        statusz_doc = store.statusz()
+        try:
+            trace_doc = store.tracez()
+        except PermissionError:
+            raise  # auth rejection: the outer handler's diagnosis
+        except IOError as exc:
+            # ONLY the pre-/tracez docserver (404) degrades to a bundle
+            # without a server-side trace; any other failure (retry
+            # exhaustion, breaker open, 5xx) is a failed capture and
+            # must error, not exit 0 with a trace-less bundle
+            if "HTTP 404" not in str(exc):
+                raise
+            print("note: server has no /tracez endpoint; bundling an "
+                  "empty trace", file=sys.stderr)
+            trace_doc = {"traceEvents": [], "displayTimeUnit": "ms"}
+    except PermissionError as exc:
+        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"cannot reach {args.connstr}: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        store.close()
+    out = obs_profile.write_bundle(
+        args.out, metrics_text=metrics_text, statusz_doc=statusz_doc,
+        trace_doc=trace_doc)
+    n_ev = len(trace_doc.get("traceEvents", []))
+    print(f"profile bundle written to {out} ({n_ev} trace events); "
+          f"open trace.json in https://ui.perfetto.dev")
+    return 0
+
+
 def cmd_warmup(argv: List[str]) -> int:
     """Prime the persistent XLA compilation cache for the device engine
     (cold compile is ~100s at bench shapes — the lax.sort comparator;
@@ -538,7 +670,8 @@ def cmd_warmup(argv: List[str]) -> int:
 COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "wordcount": cmd_wordcount, "drop": cmd_drop,
             "blobserver": cmd_blobserver, "docserver": cmd_docserver,
-            "warmup": cmd_warmup, "status": cmd_status}
+            "warmup": cmd_warmup, "status": cmd_status,
+            "profile": cmd_profile}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -231,12 +231,15 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         """Exposition plane: ``/metrics`` (Prometheus text over the
         process-global registry, with job-board depth gauges refreshed at
-        scrape time), ``/statusz`` (JSON cluster snapshot), ``/healthz``.
-        /metrics and /statusz are auth-gated like the RPC plane (the
-        board's contents leak through both); /healthz is open — it
-        returns a static liveness body and nothing else, and orchestrator
-        probes (k8s httpGet, load balancers) cannot send a bearer token."""
-        if self.path not in ("/metrics", "/statusz", "/healthz"):
+        scrape time), ``/statusz`` (JSON cluster snapshot), ``/tracez``
+        (this process's span ring as Chrome trace JSON — the ``profile``
+        CLI's bundle feed), ``/healthz``.  /metrics, /statusz and
+        /tracez are auth-gated like the RPC plane (the board's contents
+        leak through all three); /healthz is open — it returns a static
+        liveness body and nothing else, and orchestrator probes (k8s
+        httpGet, load balancers) cannot send a bearer token."""
+        if self.path not in ("/metrics", "/statusz", "/tracez",
+                             "/healthz"):
             return self._respond(404, b"{}")
         if self.path == "/healthz":
             _SCRAPES.inc(path=self.path)
@@ -249,6 +252,9 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                 update_board_gauges(self.store)
                 body = _metrics.REGISTRY.render().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path == "/tracez":
+                body = json.dumps(TRACER.chrome_trace()).encode()
+                ctype = "application/json"
             else:
                 body = json.dumps(cluster_status(self.store)).encode()
                 ctype = "application/json"
@@ -474,6 +480,16 @@ class HttpDocStore(DocStore):
         if status != 200:
             raise IOError(f"metrics: HTTP {status}")
         return raw.decode()
+
+    def tracez(self) -> Dict[str, Any]:
+        """Fetch the server's /tracez Chrome trace snapshot (the
+        ``profile`` CLI's bundle feed)."""
+        status, raw = self._client.request("GET", "/tracez")
+        if status == 401:
+            raise PermissionError("tracez: auth rejected")
+        if status != 200:
+            raise IOError(f"tracez: HTTP {status}")
+        return json.loads(raw)
 
     def close(self) -> None:
         self._client.close()

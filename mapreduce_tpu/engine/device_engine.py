@@ -47,10 +47,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import metrics as _obs
+from ..obs import profile as _profile
+from ..obs.trace import TRACER
 from ..ops.segscan import SENTINEL, sorted_unique_reduce
 from ..parallel.shuffle import partition_exchange
+from ..utils.jax_compat import pcast, shard_map
 
 AXIS = "data"
+
+# -- device-plane instruments (obs/): live counters for the exposition
+#    plane plus per-wave histograms on the µs-capable DEVICE_BUCKETS
+#    (LATENCY_BUCKETS' 1ms floor collapses sub-millisecond waves) -----------
+_WAVES = _obs.counter("mrtpu_device_waves_total",
+                      "device-engine waves executed")
+_RETRIES = _obs.counter("mrtpu_device_retries_total",
+                        "capacity-overflow recompile retries")
+_STAGE_SECONDS = _obs.counter(
+    "mrtpu_device_seconds_total",
+    "device-engine wall seconds by stage (labels: stage)")
+_WAVE_SECONDS = _obs.histogram(
+    "mrtpu_device_wave_seconds",
+    "per-wave device-plane stage seconds on the DEVICE_BUCKETS preset "
+    "(labels: stage=wave|upload|compute|readback; compute is the "
+    "dispatch+fold time — device execution is async until readback)",
+    buckets=_obs.DEVICE_BUCKETS)
 
 
 @dataclass(frozen=True)
@@ -240,7 +261,7 @@ class DeviceEngine:
             N = k * T
 
             def varying(a):
-                return jax.lax.pcast(a, AXIS, to="varying")
+                return pcast(a, AXIS, to="varying")
 
             # phase 1: map + append into the device-resident record buffer
             buf_k = varying(jnp.full((N, 2), SENTINEL, jnp.uint32))
@@ -318,7 +339,7 @@ class DeviceEngine:
                     expand(local_oflow), expand(needs))
 
         sharded = P(AXIS)
-        fn = jax.shard_map(
+        fn = shard_map(
             per_device, mesh=self.mesh,
             in_specs=(sharded, sharded, P()),
             out_specs=(sharded,) * 6,
@@ -349,7 +370,7 @@ class DeviceEngine:
                     expand(fin.payload), expand(fin.valid), expand(oflow))
 
         sharded = P(AXIS)
-        fn = jax.shard_map(merge_dev, mesh=self.mesh,
+        fn = shard_map(merge_dev, mesh=self.mesh,
                            in_specs=(sharded,) * 4,
                            out_specs=(sharded,) * 5)
         return jax.jit(fn)
@@ -460,6 +481,64 @@ class DeviceEngine:
             tile_records=(min(cfg.tile_records * 2, cfg.tile)
                           if map_dropped else cfg.tile_records),
         )
+
+    # -- cost model (obs/profile.py: FLOPs/MFU accounting) ------------------
+
+    def _program_costs(self, cfg: EngineConfig, shapes) -> dict:
+        """FLOPs / bytes-accessed of ONE wave program.  Prefers XLA's
+        own cost model: ``lower().compile()`` on the shapes the run
+        dispatched hits the in-process executable cache (the program
+        already compiled for dispatch — measured ~1ms, not a recompile),
+        and ``cost_analysis()`` reads the compiled module.  Backends
+        without a usable analysis fall back to the analytic
+        sort-hierarchy estimate, labelled ``source="analytic"``.
+        Cached per (cfg, shape) — one trace per engine config."""
+        key = ("cost", cfg.cache_key(),
+               tuple((tuple(s.shape), str(s.dtype)) for s in shapes))
+        if key not in self._compiled:
+            try:
+                compiled = self._get_compiled(cfg).lower(*shapes).compile()
+                costs = _profile.program_costs(compiled)
+            except Exception:
+                costs = None  # fall through to the analytic estimate
+            if costs is None:
+                costs = self._analytic_costs(cfg, shapes)
+                costs["source"] = "analytic"
+            else:
+                costs["source"] = "measured"
+            self._compiled[key] = costs
+        return self._compiled[key]
+
+    def _analytic_costs(self, cfg: EngineConfig, shapes) -> dict:
+        """Analytic fallback: the record count comes from tracing
+        map_fn's output aval on one chunk (exact T — nothing declared up
+        front, matching the engine's shape-inference contract), record
+        width from the value/payload dtypes; obs/profile.analytic_costs
+        turns that into the sort-dominated flops/bytes estimate."""
+        chunk_rows = int(shapes[0].shape[0])
+        row_shape = tuple(shapes[0].shape[1:])
+        input_bytes = int(chunk_rows
+                          * np.prod(row_shape, dtype=np.int64).item()
+                          * np.dtype(shapes[0].dtype).itemsize)
+        try:
+            row = jax.ShapeDtypeStruct(row_shape, shapes[0].dtype)
+            idx = jax.ShapeDtypeStruct((), np.int32)
+            k0, v0, p0, _valid, _of = jax.eval_shape(
+                lambda c, i: self.map_fn(c, i, cfg), row, idx)
+            T = int(k0.shape[0])
+            Q = int(p0.shape[1])
+            val_bytes = (int(np.prod(v0.shape[1:], dtype=np.int64).item()
+                             or 1)
+                         * np.dtype(v0.dtype).itemsize)
+        except Exception:
+            # un-traceable aval probe: assume wordcount-ish density
+            L = int(np.prod(row_shape, dtype=np.int64).item()) or 1
+            T = max(L // max(cfg.tile, 1), 1) * cfg.tile_records
+            Q, val_bytes = 1, 4
+        n_records = chunk_rows * T
+        record_bytes = 8 + val_bytes + 4 * Q + 1  # key + value + payload
+        return _profile.analytic_costs(input_bytes, n_records,
+                                       record_bytes)
 
     def precompile(self, row_shape, row_dtype=np.uint8,
                    k: int = None) -> float:
@@ -619,7 +698,9 @@ class DeviceEngine:
 
         t_upload = 0.0
         t_compute = 0.0
+        t_attempt_compute = 0.0  # final attempt only (the MFU clock)
         retries = 0
+        cost_shapes = None  # avals of the dispatched wave (cost model)
         try:
             depth = self._max_inflight_programs()
             for attempt in range(max_retries + 1):
@@ -628,59 +709,127 @@ class DeviceEngine:
                 t0 = time.monotonic()
                 t_blocked = 0.0
                 acc = None
-                oflows = []
+                merge_oflows = []
                 wave_oflows = []
+                wave_oflow_vals = {}
                 need_arrays = []
-                for w in range(W):
-                    tb = time.monotonic()
-                    if pairs is not None:
-                        ci, ii = pairs[w]
-                    else:
-                        ci, ii = feeder.get(w)
-                    # wave w's program must not queue against an
-                    # in-flight transfer (measured to throttle the
-                    # tunnelled link); the wait is charged to upload
-                    jax.block_until_ready(ci)
-                    t_blocked += time.monotonic() - tb
-                    if w >= depth:
-                        # bound the dispatch queue via a VALUE readback:
-                        # on the tunnelled platform block_until_ready on
-                        # a small array can return before execution
-                        # finishes (measured), which would quietly void
-                        # both the HBM bound and the CPU rendezvous
-                        # serialization
-                        self._host(wave_oflows[w - depth])
-                    out = fn(ci, ii, n_real)
-                    oflows.append(out[4])
-                    wave_oflows.append(out[4])
-                    need_arrays.append(out[5])
-                    if acc is None:
-                        acc = out[:4]
-                    else:
-                        # fold wave w into the running uniques (2C rows —
-                        # shape-stable, so ONE merge compile serves any W)
-                        merged = merge(
-                            *(jnp.concatenate([acc[i], out[i]], axis=1)
-                              for i in range(4)))
-                        acc = merged[:4]
-                        oflows.append(merged[4])
-                    del out
-                    # wave w is consumed: drop its input references so
-                    # the HBM frees the moment its program completes
-                    if pairs is not None:
-                        pairs.pop(w, None)
-                    else:
-                        feeder.release(w)
-                    del ci, ii
-                keys, vals, pay, valid = acc
-                # the (tiny) overflow readbacks force program completion
-                total_oflow = sum(int(self._host(o).sum())
-                                  for o in oflows)
+                # per-attempt span tree: device_run ⊃ wave ⊃ {upload,
+                # compute, readback}, joined (via the thread's current
+                # span) under the owning job's trace.  Waves OVERLAP —
+                # wave w+1 uploads while wave w computes and a wave's
+                # readback lands depth waves later — so they are
+                # detached spans closed by the readback that proves the
+                # wave's device work finished, not lexical scopes.
+                run_sp = TRACER.begin("device_run", start=t0,
+                                      attempt=attempt, waves=W)
+                wave_spans = {}
+
+                def _read_wave_oflow(j: int) -> None:
+                    # the (tiny) overflow VALUE readback both bounds the
+                    # dispatch queue and proves wave j's program
+                    # finished — so it records the wave's readback child
+                    # and closes the wave span
+                    tr0 = time.monotonic()
+                    wave_oflow_vals[j] = int(
+                        self._host(wave_oflows[j]).sum())
+                    tr1 = time.monotonic()
+                    sp = wave_spans.pop(j, None)
+                    if sp is not None:
+                        TRACER.end(TRACER.begin("readback", parent=sp,
+                                                start=tr0,
+                                                kind="overflow"), tr1)
+                        TRACER.end(sp, tr1)
+                        _WAVE_SECONDS.observe(tr1 - sp.t0, stage="wave")
+                    _WAVE_SECONDS.observe(tr1 - tr0, stage="readback")
+
+                try:
+                    for w in range(W):
+                        tb = time.monotonic()
+                        wave_spans[w] = TRACER.begin("wave", parent=run_sp,
+                                                     start=tb, wave=w)
+                        if pairs is not None:
+                            ci, ii = pairs[w]
+                        else:
+                            ci, ii = feeder.get(w)
+                        # wave w's program must not queue against an
+                        # in-flight transfer (measured to throttle the
+                        # tunnelled link); the wait is charged to upload
+                        jax.block_until_ready(ci)
+                        t_up = time.monotonic()
+                        TRACER.end(TRACER.begin("upload",
+                                                parent=wave_spans[w],
+                                                start=tb), t_up)
+                        _WAVE_SECONDS.observe(t_up - tb, stage="upload")
+                        t_blocked += t_up - tb
+                        if w >= depth:
+                            # bound the dispatch queue via a VALUE
+                            # readback: on the tunnelled platform
+                            # block_until_ready on a small array can
+                            # return before execution finishes
+                            # (measured), which would quietly void both
+                            # the HBM bound and the CPU rendezvous
+                            # serialization
+                            _read_wave_oflow(w - depth)
+                        tc0 = time.monotonic()
+                        out = fn(ci, ii, n_real)
+                        if cost_shapes is None:
+                            cost_shapes = tuple(
+                                jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                     sharding=a.sharding)
+                                for a in (ci, ii, n_real))
+                        wave_oflows.append(out[4])
+                        need_arrays.append(out[5])
+                        if acc is None:
+                            acc = out[:4]
+                        else:
+                            # fold wave w into the running uniques (2C
+                            # rows — shape-stable, so ONE merge compile
+                            # serves any W)
+                            merged = merge(
+                                *(jnp.concatenate([acc[i], out[i]],
+                                                  axis=1)
+                                  for i in range(4)))
+                            acc = merged[:4]
+                            merge_oflows.append(merged[4])
+                        tc1 = time.monotonic()
+                        TRACER.end(TRACER.begin("compute",
+                                                parent=wave_spans[w],
+                                                start=tc0,
+                                                async_dispatch=True),
+                                   tc1)
+                        _WAVE_SECONDS.observe(tc1 - tc0, stage="compute")
+                        del out
+                        # wave w is consumed: drop its input references
+                        # so the HBM frees the moment its program
+                        # completes
+                        if pairs is not None:
+                            pairs.pop(w, None)
+                        else:
+                            feeder.release(w)
+                        del ci, ii
+                    keys, vals, pay, valid = acc
+                    # the (tiny) overflow readbacks force program
+                    # completion — and close each wave's span
+                    for w in range(W):
+                        if w not in wave_oflow_vals:
+                            _read_wave_oflow(w)
+                    total_oflow = (sum(wave_oflow_vals.values())
+                                   + sum(int(self._host(o).sum())
+                                         for o in merge_oflows))
+                finally:
+                    # a failed attempt must not leak open wave spans
+                    # into the next attempt's timeline
+                    t_now = time.monotonic()
+                    for sp in wave_spans.values():
+                        TRACER.end(sp, t_now, truncated=True)
+                    wave_spans.clear()
+                    TRACER.end(run_sp)
                 # every attempt's transfer waits count: capacity retries
                 # re-upload (inputs were freed wave by wave) and that cost
                 # must show in the stats meant to expose it
                 t_upload += t_blocked
-                t_compute += time.monotonic() - t0 - t_blocked
+                t_attempt_compute = time.monotonic() - t0 - t_blocked
+                t_compute += t_attempt_compute
                 if total_oflow == 0 or attempt == max_retries:
                     break  # done, or out of retries (don't size a cfg
                     # that will never run)
@@ -715,29 +864,37 @@ class DeviceEngine:
         # sliced readback: only the live prefix of each partition's
         # capacity-padded result crosses the (slow) device->host link
         t0 = time.monotonic()
-        n_live = self._host(valid.sum(axis=1))
-        width = max(1, int(n_live.max()))
-        keys_h, vals_h, pay_h, valid_h = self._host(
-            keys[:, :width], vals[:, :width], pay[:, :width],
-            valid[:, :width])
+        with TRACER.span("readback", stage="result"):
+            n_live = self._host(valid.sum(axis=1))
+            width = max(1, int(n_live.max()))
+            keys_h, vals_h, pay_h, valid_h = self._host(
+                keys[:, :width], vals[:, :width], pay[:, :width],
+                valid[:, :width])
         result = DeviceResult(keys_h, vals_h, pay_h, valid_h, total_oflow)
         t_readback = time.monotonic() - t0
         # live counters for the exposition plane regardless of whether
         # the caller asked for a timings dict: per-wave upload/compute/
         # readback seconds are the device-path hot-path metrics
-        from ..obs import metrics as _obs
-
-        _obs.counter("mrtpu_device_waves_total",
-                     "device-engine waves executed").inc(W)
-        _obs.counter("mrtpu_device_retries_total",
-                     "capacity-overflow recompile retries").inc(retries)
-        sec = _obs.counter(
-            "mrtpu_device_seconds_total",
-            "device-engine wall seconds by stage (labels: stage)")
-        sec.inc(t_upload, stage="upload")
-        sec.inc(t_compute, stage="compute")
-        sec.inc(t_readback, stage="readback")
+        _WAVES.inc(W)
+        _RETRIES.inc(retries)
+        _STAGE_SECONDS.inc(t_upload, stage="upload")
+        _STAGE_SECONDS.inc(t_compute, stage="compute")
+        _STAGE_SECONDS.inc(t_readback, stage="readback")
+        # cost model: FLOPs/bytes of the final wave program (XLA
+        # cost_analysis, analytic fallback on backends without one) ->
+        # flop/byte counters + derived MFU / roofline gauges.  The MFU
+        # clock is the FINAL attempt's compute seconds — a retried
+        # attempt ran a differently-sized program whose flops aren't the
+        # ones counted.
+        derived = {}
+        if cost_shapes is not None:
+            costs = self._program_costs(cfg, cost_shapes)
+            derived = _profile.record_run(
+                costs, waves=W, compute_s=t_attempt_compute,
+                n_dev=self.n_dev,
+                device=next(iter(self.mesh.devices.flat)))
         if timings is not None:
+            timings.update(derived)
             timings["waves"] = W
             timings["retries"] = retries
             if feeder is not None:

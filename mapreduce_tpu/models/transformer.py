@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 from ..ops.flash_attention import flash_attention
 from ..parallel.ring import ring_attention
 
@@ -364,7 +366,7 @@ class TransformerTrainer:
         def sharded_loss(params, tokens, targets):
             return loss_local(params, tokens, targets, cfg, n_model)
 
-        loss_fn = jax.shard_map(
+        loss_fn = shard_map(
             sharded_loss, mesh=mesh,
             in_specs=(pspecs, tok_spec, tok_spec), out_specs=P())
 

@@ -6,14 +6,20 @@
 * :mod:`.trace` — monotonic span tracer with cross-plane header
   propagation and Chrome trace-event export (Perfetto-loadable);
 * :mod:`.statusz` — the /statusz JSON cluster snapshot and scrape-time
-  job-board depth gauges.
+  job-board depth gauges;
+* :mod:`.profile` — device-plane cost model (FLOPs/bytes via XLA
+  ``cost_analysis`` with an analytic fallback), MFU/roofline gauges,
+  and self-contained profile bundles (trace + metrics + statusz);
+* :mod:`.benchgate` — the bench regression gate (``--check``).
 
 Pure stdlib, imported by the hot paths (httpclient, docserver, worker,
 job, storage, engine) — keep it dependency-free and fast.
 """
 
 from .metrics import (  # noqa: F401
-    LATENCY_BUCKETS, REGISTRY, Registry, Counter, Gauge, Histogram,
-    counter, gauge, histogram, parse_prometheus)
+    DEVICE_BUCKETS, LATENCY_BUCKETS, REGISTRY, Registry, Counter, Gauge,
+    Histogram, counter, gauge, histogram, parse_prometheus)
 from .trace import TRACE_HEADER, TRACER, Tracer  # noqa: F401
 from .statusz import cluster_status, update_board_gauges  # noqa: F401
+from .profile import (  # noqa: F401
+    device_snapshot, load_bundle, validate_trace, write_bundle)

@@ -44,6 +44,16 @@ LATENCY_BUCKETS: Tuple[float, ...] = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, float("inf"))
 
+#: preset device-plane buckets (seconds): µs-range lower rungs for the
+#: engine's per-wave stage timings.  LATENCY_BUCKETS was chosen for
+#: RPC-scale work and its 1ms floor collapses sub-millisecond device
+#: waves (a dispatch is ~100µs, a small wave's upload wait can be tens
+#: of µs) into one bucket; this ladder resolves 10µs .. 30s.
+DEVICE_BUCKETS: Tuple[float, ...] = (
+    10e-6, 25e-6, 50e-6, 100e-6, 250e-6, 500e-6,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, float("inf"))
+
 _NAME_RX = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RX = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 

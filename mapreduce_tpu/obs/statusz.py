@@ -81,9 +81,15 @@ def _workers(store, colls, now: float) -> Dict[str, Dict[str, Any]]:
 
 
 def cluster_status(store, now: Optional[float] = None) -> Dict[str, Any]:
-    """The /statusz document: one entry per task database on the board."""
+    """The /statusz document: one entry per task database on the board,
+    plus the serving process's device-plane section (engine FLOPs/MFU —
+    nonzero only where the engine actually ran; per-task device numbers
+    travel in the persisted ``stats.device`` doc either way)."""
+    from .profile import device_snapshot  # late: profile pulls trace
+
     now = time.time() if now is None else now
-    out: Dict[str, Any] = {"now": now, "tasks": {}}
+    out: Dict[str, Any] = {"now": now, "tasks": {},
+                           "device": device_snapshot()}
     for db, colls in sorted(_dbnames(store).items()):
         task_doc = None
         if "task" in colls:

@@ -13,20 +13,34 @@ path).  Export is the Chrome trace-event JSON array format — complete
 ("ph": "X") events with microsecond ``ts``/``dur`` on real thread ids —
 loadable directly in Perfetto / chrome://tracing.
 
-The buffer is bounded (:attr:`Tracer.max_events`); overflow drops the
-newest spans and counts them in ``mrtpu_trace_dropped_total`` rather
-than growing without bound inside a long-lived worker.
+The buffer is a bounded RING (:attr:`Tracer.max_events`): overflow
+evicts the OLDEST spans — a long-lived worker's export always holds its
+most recent activity, which is what a profile capture wants — and every
+eviction is counted in ``mrtpu_trace_dropped_total`` rather than
+silently discarded.
+
+Two span surfaces:
+
+* :meth:`Tracer.span` — the lexical context manager (per-thread parent
+  stack); right for code whose spans nest like its scopes do.
+* :meth:`Tracer.begin` / :meth:`Tracer.end` — DETACHED spans with an
+  explicit parent, for work whose lifetime crosses lexical scope: the
+  device engine's waves overlap (wave w+1 uploads while wave w
+  computes, and a wave's readback lands after later waves dispatched),
+  so their spans are built by hand and closed when the readback proves
+  the device work finished.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
 import threading
 import time
 import uuid
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
 
 from .metrics import counter
 
@@ -64,7 +78,7 @@ class Tracer:
     def __init__(self, max_events: int = 100_000) -> None:
         self.max_events = max_events
         self._lock = threading.Lock()
-        self._events: List[Dict[str, Any]] = []
+        self._events: Deque[Dict[str, Any]] = collections.deque()
         self._tls = threading.local()
 
     # -- span stack -------------------------------------------------------
@@ -137,6 +151,34 @@ class Tracer:
                   parent[1] if parent else None, t0, dict(args))
         self._record(sp, t1)
 
+    # -- detached spans (explicit parentage, cross-scope lifetime) ---------
+
+    def begin(self, name: str, parent: Optional[Span] = None,
+              start: Optional[float] = None, **args: Any) -> Span:
+        """Open a DETACHED span — not pushed on the thread's stack —
+        parented under *parent* (a live :class:`Span`) or, when None,
+        under the thread's current span context.  For work whose
+        lifetime crosses lexical scope (the engine's overlapping waves);
+        close it with :meth:`end`.  All timestamps are
+        ``time.monotonic()``."""
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            cur = self.current()
+            trace_id = cur[0] if cur else _new_id()
+            parent_id = cur[1] if cur else None
+        return Span(name, trace_id, _new_id(), parent_id,
+                    start if start is not None else time.monotonic(),
+                    dict(args))
+
+    def end(self, sp: Span, stop: Optional[float] = None,
+            **args: Any) -> None:
+        """Close a detached span from :meth:`begin` (idempotence is the
+        caller's job — ending twice records the span twice)."""
+        if args:
+            sp.args.update(args)
+        self._record(sp, stop if stop is not None else time.monotonic())
+
     def _record(self, sp: Span, t1: float) -> None:
         event = {
             "name": sp.name,
@@ -150,11 +192,16 @@ class Tracer:
                      "parent_id": sp.parent_id, **sp.args},
         }
         _SPANS.inc(name=sp.name)
+        dropped = 0
         with self._lock:
-            if len(self._events) >= self.max_events:
-                _DROPPED.inc()
-                return
             self._events.append(event)
+            # ring semantics: evict the OLDEST events past the bound, so
+            # an export always holds the newest activity
+            while len(self._events) > self.max_events:
+                self._events.popleft()
+                dropped += 1
+        if dropped:
+            _DROPPED.inc(dropped)
 
     # -- export -----------------------------------------------------------
 

@@ -26,6 +26,7 @@ from .coord.job import map_results_prefix
 from .coord.task import Task, make_job
 from .obs import metrics as _metrics
 from .obs.metrics import REGISTRY
+from .obs.trace import TRACER
 from .utils.constants import (
     STATUS, TASK_STATUS, DEFAULT_SLEEP, MAX_JOB_RETRIES,
     MAX_TASKFN_VALUE_SIZE)
@@ -270,6 +271,11 @@ class Server:
         # device re-runs are idempotent whole-phase: forget prior jobs
         self.cnn.connect().remove(coll, {})
         pairs = self._collect_task_pairs()
+        # claim-equivalent: the server stakes the __device__ job on the
+        # board.  Backdating the root span to here gives the device
+        # plane the same claim -> run -> write trace the worker path
+        # records, with the engine's wave spans nested under run.
+        t_claim0 = time.monotonic()
         job = make_job("__device__", {"pairs": len(pairs)})
         now = docstore.now()
         job.update({"worker": "server",
@@ -278,51 +284,63 @@ class Server:
                     "lease_expires": now + self.task.job_lease})
         self.task.insert_jobs(coll, [job])
         self.task.set_task_status(TASK_STATUS.MAP)
+        t_claim1 = time.monotonic()
 
-        ds = spec.load_device(self.params["mapfn"])
-        spec.load_role(self.params["mapfn"], "mapfn").ensure_init(
-            self.params.get("init_args"))
-        mesh = self._device_mesh()
-        # monotonic for the duration fields; wall clock (docstore.now)
-        # only for the started_time/written_time timestamps
-        t_cpu, t_real = time.process_time(), time.monotonic()
-        chunks = ds.prepare(pairs, mesh)
-        engine = self._get_device_engine(ds, mesh)
-        timings: Dict[str, Any] = {}
-        # on_overflow="return" so the error names the MODULE knob (the
-        # engine's own raise points at EngineConfig generically)
-        res = engine.run(chunks, timings=timings, on_overflow="return")
-        if res.overflow:
-            raise RuntimeError(
-                f"device phase overflowed capacities by {res.overflow} "
-                "rows even after retries; raise the module's EngineConfig")
-        out_pairs = list(ds.result(chunks, res))
+        with TRACER.span("job", start=t_claim0, job="__device__",
+                         phase="device", worker="server") as root:
+            TRACER.record("claim", t_claim0, t_claim1,
+                          worker="server", job="__device__")
+            ds = spec.load_device(self.params["mapfn"])
+            spec.load_role(self.params["mapfn"], "mapfn").ensure_init(
+                self.params.get("init_args"))
+            mesh = self._device_mesh()
+            # monotonic for the duration fields; wall clock (docstore.now)
+            # only for the started_time/written_time timestamps
+            t_cpu, t_real = time.process_time(), time.monotonic()
+            timings: Dict[str, Any] = {}
+            with TRACER.span("run", phase="device", job="__device__"):
+                chunks = ds.prepare(pairs, mesh)
+                engine = self._get_device_engine(ds, mesh)
+                # on_overflow="return" so the error names the MODULE
+                # knob (the engine's own raise points at EngineConfig
+                # generically)
+                res = engine.run(chunks, timings=timings,
+                                 on_overflow="return")
+                if res.overflow:
+                    raise RuntimeError(
+                        f"device phase overflowed capacities by "
+                        f"{res.overflow} rows even after retries; raise "
+                        "the module's EngineConfig")
+                out_pairs = list(ds.result(chunks, res))
 
-        self.task.set_task_status(TASK_STATUS.REDUCE)
-        # one key-sorted result partition file in the shared record
-        # format: finalfn cannot tell which plane produced it.  Stale
-        # result partitions from a crashed (possibly host-plane) run are
-        # cleared first — _result_pairs merges every result.P* file, so a
-        # leftover P00001 would silently blend into the device output
-        storage = storage_mod.router(self.params["storage"],
-                                     auth=self.cnn.auth_token(),
-                                     retry=self.cnn.retry_policy)
-        storage.remove_many(self._result_partitions(storage))
-        b = storage.builder()
-        for key, values in sorted(out_pairs,
-                                  key=lambda kv: sort_key(kv[0])):
-            check_serializable(key)
-            values = list(values)
-            check_serializable(values)
-            b.write_record_line(serialize_record(key, values))
-        b.build(f"{self.task.red_results_ns()}.P00000")
-        self.cnn.connect().update(
-            coll, {"_id": "__device__"},
-            {"$set": {"status": int(STATUS.WRITTEN),
-                      "written_time": docstore.now(),
-                      "cpu_time": time.process_time() - t_cpu,
-                      "real_time": time.monotonic() - t_real,
-                      "device_timings": timings}})
+            self.task.set_task_status(TASK_STATUS.REDUCE)
+            # one key-sorted result partition file in the shared record
+            # format: finalfn cannot tell which plane produced it.  Stale
+            # result partitions from a crashed (possibly host-plane) run
+            # are cleared first — _result_pairs merges every result.P*
+            # file, so a leftover P00001 would silently blend into the
+            # device output
+            with TRACER.span("write", phase="device", job="__device__"):
+                storage = storage_mod.router(self.params["storage"],
+                                             auth=self.cnn.auth_token(),
+                                             retry=self.cnn.retry_policy)
+                storage.remove_many(self._result_partitions(storage))
+                b = storage.builder()
+                for key, values in sorted(out_pairs,
+                                          key=lambda kv: sort_key(kv[0])):
+                    check_serializable(key)
+                    values = list(values)
+                    check_serializable(values)
+                    b.write_record_line(serialize_record(key, values))
+                b.build(f"{self.task.red_results_ns()}.P00000")
+                self.cnn.connect().update(
+                    coll, {"_id": "__device__"},
+                    {"$set": {"status": int(STATUS.WRITTEN),
+                              "written_time": docstore.now(),
+                              "cpu_time": time.process_time() - t_cpu,
+                              "real_time": time.monotonic() - t_real,
+                              "device_timings": timings}})
+            root.args["outcome"] = "written"
         self._last_device_timings = timings
         logger.info("device phase: %d splits -> %d uniques, timings %s",
                     len(pairs), len(out_pairs), timings)

@@ -35,6 +35,20 @@ import jax  # noqa: E402
 if not _USE_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+# Version bridge for test code that spells the current JAX API names
+# directly (jax.shard_map / jax.lax.pcast): install the same aliases the
+# package itself gets from utils/jax_compat, so a CI container pinning an
+# older JAX runs the suite instead of failing every sharded test on an
+# AttributeError.  No-ops on current JAX.
+if not hasattr(jax, "shard_map"):
+    from mapreduce_tpu.utils.jax_compat import shard_map as _shard_map
+
+    jax.shard_map = _shard_map
+if not hasattr(jax.lax, "pcast"):
+    from mapreduce_tpu.utils.jax_compat import pcast as _pcast
+
+    jax.lax.pcast = _pcast
+
 
 # -- failure telemetry artifacts (@pytest.mark.telemetry) -------------------
 # A failing chaos test is a distributed-systems flake by construction;

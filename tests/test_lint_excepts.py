@@ -11,7 +11,16 @@
    steps the clock mid-run (the satellite fix of the observability PR).
    Durations use ``time.monotonic()``; wall-clock timestamps are minted
    in ONE place (coord/docstore.now) and compared, never subtracted
-   pairwise on one host.
+   pairwise on one host.  The walk covers the WHOLE package, so new
+   modules (obs/profile.py, obs/benchgate.py — the device-plane
+   profiling layer) are covered the moment they land; they mint their
+   persisted timestamps (bundle manifests, history entries) through
+   docstore.now and stay off the allowlist.
+3. Device-plane span modules are MONOTONIC-ONLY: every ``time.*`` call
+   in the modules that build profiler spans/timings must come from the
+   monotonic family — a span backed by any steppable or
+   resolution-mismatched clock would corrupt the per-wave timeline the
+   profiling layer exists to produce.
 
 AST-based so comments/strings can't fool them and formatting can't
 evade them."""
@@ -111,3 +120,45 @@ def test_no_wall_clock_time_outside_allowlist():
         "wall-clock time.time() outside the timestamp allowlist — use "
         "time.monotonic() for durations, docstore.now() for persisted "
         "timestamps: " + ", ".join(offenders))
+
+
+#: modules whose time readings become profiler spans or per-wave stage
+#: timings: the engine's span emitters and the span/cost plumbing
+#: itself.  Everything here feeds ts/dur fields in the Chrome trace, so
+#: only the monotonic clock family may appear at all.
+_MONOTONIC_ONLY_MODULES = {
+    os.path.join("mapreduce_tpu", "engine", "device_engine.py"),
+    os.path.join("mapreduce_tpu", "engine", "wordcount.py"),
+    os.path.join("mapreduce_tpu", "obs", "trace.py"),
+    os.path.join("mapreduce_tpu", "obs", "profile.py"),
+}
+
+#: the monotonic family plus the two non-clock time functions
+#: (process_time is monotonic by definition; sleep reads no clock)
+_MONOTONIC_FAMILY = {"monotonic", "monotonic_ns",
+                     "process_time", "process_time_ns", "sleep"}
+
+
+def test_device_plane_spans_use_monotonic_clock_only():
+    """Every ``time.<fn>()`` call in the span-emitting modules must be
+    from the monotonic family: a device-engine span built from
+    ``time.time()`` / ``perf_counter()`` (or any future steppable or
+    differently-based clock) would silently break the wave timeline's
+    nesting against spans recorded by the monotonic tracer."""
+    offenders = []
+    for rel in sorted(_MONOTONIC_ONLY_MODULES):
+        path = os.path.join(os.path.dirname(PKG_ROOT), rel)
+        with open(path, "r") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "time"
+                    and node.func.attr not in _MONOTONIC_FAMILY):
+                offenders.append(
+                    f"{rel}:{node.lineno} time.{node.func.attr}()")
+    assert not offenders, (
+        "non-monotonic clock call in a device-plane span module — "
+        "profiler spans must be built from time.monotonic(): "
+        + ", ".join(offenders))
