@@ -66,11 +66,17 @@ def gate_specs():
     """Per-metric tolerances for --check, sized to this fixture's
     measured variance: compute_s is stable (±5% across the recorded
     history), the best-of-N wall value swings more (readback rides the
-    tunnelled link), materialize depends on host load."""
+    tunnelled link), materialize depends on host load.
+    europarl_wordcount_compute_s is the device-plane headline — the
+    fused-engine metric the perf PRs move — gated as its own top-level
+    key with the wall key's tolerance and REQUIRED so a run that stops
+    reporting it fails loudly."""
     from mapreduce_tpu.obs.benchgate import MetricSpec
 
     return [
         MetricSpec("value", rel_tol=0.50, required=True),
+        MetricSpec("europarl_wordcount_compute_s", rel_tol=0.50,
+                   required=True),
         MetricSpec("timings.compute_s", rel_tol=0.35),
         MetricSpec("timings.readback_s", rel_tol=1.00),
         MetricSpec("timings.materialize_s", rel_tol=1.50),
@@ -160,8 +166,10 @@ def check_smoke() -> int:
        derived from the history itself (obs/benchgate.synthetic_entry):
        the medians must pass, an injected 2x slowdown must be flagged;
     2. a tiny CPU-sized device-engine wordcount, judged purely from the
-       obs registry: waves ran, the cost model recorded FLOPs (analytic
-       fallback included), the MFU gauge landed.
+       obs registry: waves ran, the FUSED execution model held (exactly
+       one program dispatch per wave, zero merge-program dispatches —
+       i.e. zero per-wave merge readbacks), the cost model recorded
+       FLOPs (analytic fallback included), the MFU gauge landed.
     """
     from mapreduce_tpu.obs import benchgate
     from mapreduce_tpu.obs.metrics import REGISTRY
@@ -188,16 +196,42 @@ def check_smoke() -> int:
     from mapreduce_tpu.engine.device_engine import EngineConfig
     from mapreduce_tpu.parallel import make_mesh
 
+    # tile_records 128: the smoke corpus is denser than natural text
+    # (~90 words per 512-byte tile), and the dispatch-count assertion
+    # below needs a retry-free run — a capacity retry re-dispatches
+    # every wave and would muddy "exactly one program per wave"
     wc = DeviceWordCount(
         make_mesh(), chunk_len=4096,
         config=EngineConfig(local_capacity=4096, exchange_capacity=2048,
-                            out_capacity=4096, tile=512, tile_records=64))
-    corpus = b"gate smoke alpha beta gamma delta " * 500
+                            out_capacity=4096, tile=512, tile_records=128,
+                            combine_in_scan=True))
+    # 3000 repeats: enough chunks that the requested 3-way split yields
+    # a genuinely multi-wave run (>= 2 waves) on a 1-device bench host
+    # AND on the 8-device test mesh, so the fold path actually runs
+    corpus = b"gate smoke alpha beta gamma delta " * 3000
     f0 = REGISTRY.sum("mrtpu_device_flops_total")
     w0 = REGISTRY.value("mrtpu_device_waves_total")
-    counts = wc.count_bytes(corpus)
-    assert counts[b"alpha"] == 500, counts.get(b"alpha")
-    assert REGISTRY.value("mrtpu_device_waves_total") > w0
+    d0 = REGISTRY.value("mrtpu_device_dispatches_total", program="wave")
+    tm = {}
+    counts = wc.count_bytes(corpus, timings=tm, waves=3)
+    assert counts[b"alpha"] == 3000, counts.get(b"alpha")
+    waves_ran = REGISTRY.value("mrtpu_device_waves_total") - w0
+    assert waves_ran == tm["waves"] >= 2, (waves_ran, tm)
+    # the fused execution model, asserted from the registry: EXACTLY one
+    # program dispatch per wave (the fold rides inside it), zero merge
+    # dispatches — and hence zero per-wave merge readbacks, since the
+    # program that would have produced them no longer exists
+    assert tm["retries"] == 0, tm  # retries would recount dispatches
+    dispatches = (REGISTRY.value("mrtpu_device_dispatches_total",
+                                 program="wave") - d0)
+    assert dispatches == waves_ran, (
+        f"fused path dispatched {dispatches} programs for "
+        f"{waves_ran} waves (expected exactly one per wave)")
+    merge_disp = REGISTRY.value("mrtpu_device_dispatches_total",
+                                program="merge")
+    assert merge_disp == 0, (
+        f"{merge_disp} merge-program dispatches recorded — the "
+        "two-dispatch wave fold came back")
     flops = REGISTRY.sum("mrtpu_device_flops_total") - f0
     assert flops > 0, "device run recorded no FLOPs (cost model broken)"
 
@@ -205,6 +239,7 @@ def check_smoke() -> int:
         "mode": "check_smoke", "ok": True,
         "history_runs": len(history),
         "gate_flagged_2x": bad_probs,
+        "dispatches_per_wave": dispatches / waves_ran,
         "device_flops_recorded": flops,
         "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
     }, default=float))
@@ -240,9 +275,9 @@ def main() -> None:
     wc = DeviceWordCount(mesh, chunk_len=1 << 22,
                          config=bench_engine_config())
 
-    t0 = time.time()
+    t0 = time.monotonic()
     corpus = make_corpus(int(N_WORDS * scale), max(int(N_LINES * scale), 1))
-    gen_s = time.time() - t0
+    gen_s = time.monotonic() - t0
 
     n_runs = 1 if "--smoke" in sys.argv else 3
 
@@ -258,9 +293,9 @@ def main() -> None:
           f"staging {n_runs} input copies ...", file=sys.stderr, flush=True)
     staged_runs = []
     for r in range(n_runs):
-        t1 = time.time()
+        t1 = time.monotonic()
         handle = wc.stage(corpus)
-        staged_runs.append((handle, time.time() - t1))
+        staged_runs.append((handle, time.monotonic() - t1))
     ingress = [round(sec, 2) for _, sec in staged_runs]
     rate = len(corpus) / 1e6 / max(min(ingress), 1e-3)
     print(f"# ingress (verified resident): {ingress}s "
@@ -278,7 +313,7 @@ def main() -> None:
     # "31s unattributed warmup" was exactly this validation run's own
     # 307MB upload hiding inside compile_s.  Full-corpus validation now
     # happens on the first TIMED run's output (oracle diff below).
-    t_w = time.time()
+    t_w = time.monotonic()
     aot_s = wc.warm()
     # the priming slice must be EXACTLY two full waves: the auto wave
     # split shrinks k for sub-wave corpora (different program shape —
@@ -289,7 +324,7 @@ def main() -> None:
     prime_chunks = 2 * eng._rows_per_wave(wc._row_len()) * eng.n_dev
     prime = corpus[: prime_chunks * wc.chunk_len]
     wc.count_bytes(prime)
-    compile_s = time.time() - t_w
+    compile_s = time.monotonic() - t_w
     print(f"# warmup done in {compile_s:.1f}s (AOT {aot_s:.1f}s, "
           "priming on a two-wave slice)", file=sys.stderr, flush=True)
 
@@ -315,10 +350,10 @@ def main() -> None:
         handle, ingress_s = staged_runs[r]
         staged_runs[r] = None  # free each run's device copy after use
         tm = {"ingress_s": round(ingress_s, 4)}
-        t1 = time.time()
+        t1 = time.monotonic()
         got = wc.count_staged(handle, timings=tm)
         del handle
-        tm["wall_s"] = round(time.time() - t1, 4)
+        tm["wall_s"] = round(time.monotonic() - t1, 4)
         if counts is None:
             counts = got
         else:
@@ -341,7 +376,7 @@ def main() -> None:
     from mapreduce_tpu import native
 
     if native.native_available():
-        t_o = time.time()
+        t_o = time.monotonic()
         oracle = native.wordcount_bytes(corpus)
         if counts != oracle:
             only_dev = set(counts) - set(oracle)
@@ -353,7 +388,7 @@ def main() -> None:
                   f"(e.g. {bad[:3]})", file=sys.stderr)
             sys.exit(1)
         print(f"# native oracle agrees: {len(oracle)} uniques, "
-              f"{time.time() - t_o:.1f}s", file=sys.stderr, flush=True)
+              f"{time.monotonic() - t_o:.1f}s", file=sys.stderr, flush=True)
     else:
         print("# WARNING: native oracle unavailable (no g++); "
               "only the total-count check ran", file=sys.stderr)
@@ -363,6 +398,13 @@ def main() -> None:
         "value": round(wall, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_S / wall, 2),
+        # the gated device-plane headline: the best run's fused-engine
+        # compute seconds (and the per-wave figure, since wave counts
+        # can legitimately change with WAVE_BYTES tuning)
+        "europarl_wordcount_compute_s": best.get("compute_s"),
+        "compute_s_per_wave": (
+            round(best["compute_s"] / best["waves"], 4)
+            if best.get("compute_s") and best.get("waves") else None),
         "compile_s": round(compile_s, 1),
         "ingress_s": best["ingress_s"],
         "ingress_note": "host->device transfer of the corpus, measured "

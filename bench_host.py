@@ -246,9 +246,9 @@ def main() -> None:
     from mapreduce_tpu.storage import BlobServer
     from mapreduce_tpu.storage.httpstore import HttpStorage
 
-    t0 = time.time()
+    t0 = time.monotonic()
     corpus = make_corpus(int(N_WORDS * scale), max(int(N_LINES * scale), 1))
-    gen_s = time.time() - t0
+    gen_s = time.monotonic() - t0
     print(f"# corpus {len(corpus)/1e6:.0f} MB in {gen_s:.1f}s; "
           f"starting services ...", file=sys.stderr, flush=True)
 
@@ -272,7 +272,7 @@ def main() -> None:
     ]
 
     # stage the splits into cluster storage (reference: pre-loaded GridFS)
-    t1 = time.time()
+    t1 = time.monotonic()
     splits = split_corpus(corpus, n_splits)
     st = HttpStorage(f"127.0.0.1:{blob.port}")
     names = []
@@ -280,7 +280,7 @@ def main() -> None:
         name = f"europarl.{i:05d}"
         st.write(name, chunk.decode("utf-8"))
         names.append(name)
-    setup_s = time.time() - t1
+    setup_s = time.monotonic() - t1
     print(f"# {len(names)} splits staged over http in {setup_s:.1f}s",
           file=sys.stderr, flush=True)
 
@@ -302,9 +302,9 @@ def main() -> None:
             "init_args": {"blobs": names, "num_reducers": n_reducers,
                           "storage": storage_dsl},
         })
-        t2 = time.time()
+        t2 = time.monotonic()
         stats = server.loop()
-        wall = time.time() - t2
+        wall = time.monotonic() - t2
     finally:
         for p in procs:
             p.terminate()

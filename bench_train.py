@@ -42,14 +42,16 @@ def _timeit(step_fn, n=None):
     # finishes (measured: 0.2ms/step "blocked" vs 250ms/step real), while
     # np.asarray must wait for the data.  The final loss depends on every
     # prior step's params, so one readback drains the whole chain.
+    # Durations ride time.monotonic() like everywhere else — an NTP step
+    # mid-measurement must not corrupt a published steps/s number.
     for _ in range(WARMUP):
         out = step_fn()
     np.asarray(out)
-    t0 = time.time()
+    t0 = time.monotonic()
     for _ in range(n):
         out = step_fn()
     np.asarray(out)
-    return (time.time() - t0) / n
+    return (time.monotonic() - t0) / n
 
 
 def bench_mlp(mesh, platform):
@@ -77,16 +79,23 @@ def bench_mlp(mesh, platform):
 
     sec = _timeit(step)
 
-    # fused path: a whole scanned epoch per dispatch (what fit() runs)
+    # fused path: a whole scanned epoch per dispatch (what fit() runs).
+    # _train_epoch DONATES the stacked batches, so each timed call gets
+    # a fresh device-side copy of the master stacks — an on-device copy,
+    # not a host re-upload, mirroring fit()'s fresh device_put per epoch
+    # without putting the slow link inside the timed region.
     S = 100
     xs = jax.device_put(np.broadcast_to(x, (S,) + x.shape).copy(),
                         tr.epoch_sharding)
     ys = jax.device_put(np.broadcast_to(y, (S,) + y.shape).copy(),
                         tr.epoch_sharding)
+    copy2 = jax.jit(lambda a, b: (a + 0, b + 0),
+                    out_shardings=(tr.epoch_sharding, tr.epoch_sharding))
 
     def epoch():
+        xs_c, ys_c = copy2(xs, ys)
         state["params"], state["opt"], losses = tr._train_epoch(
-            state["params"], state["opt"], xs, ys)
+            state["params"], state["opt"], xs_c, ys_c)
         return losses
 
     sec_fused = _timeit(epoch, n=3) / S

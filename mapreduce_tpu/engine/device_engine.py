@@ -15,20 +15,36 @@ The device-path user contract (the traceable analogue of the host path's
     check dynamically).  Non-ACI reducers stay on the host path.
 
 Execution per device (inside ``shard_map`` over the mesh's ``data`` axis)
-is a SORT HIERARCHY, the profile-driven round-2 redesign:
+is a SORT HIERARCHY, fused into ONE dispatch per wave:
 
   1. ``lax.scan`` over the device's chunks: map_fn emits records, which
      are appended (dynamic_update_slice — contiguous, cheap) into a
-     device-resident record buffer.  No per-chunk aggregation at all.
-  2. ONE variadic ``lax.sort`` of the whole buffer by 64-bit key —
-     XLA's tuned TPU sort runs at ~160M rows/s (measured v5e), where the
-     round-1 scatter hash table managed ~3MB/s end to end.
+     device-resident record buffer.  With ``combine_in_scan`` each
+     chunk's records are first pre-reduced (the on-device combiner —
+     sort + shifted-compare run-combine at chunk scale, licensed by the
+     declared ACI monoid exactly as reducefn.lua's flags license the
+     reference's host combiner), shrinking the big-sort row count on
+     duplicate-heavy workloads like wordcount.
+  2. ONE RANK-SORT of the whole buffer by 64-bit key — ``lax.sort``
+     carries only ``[k1, k2, iota]`` and the value/payload lanes are
+     permuted by gathers afterwards (ops/segscan.py), so the comparator
+     (whose cold compile dominates the ~100s bench-shape compile) is
+     independent of record width.  XLA's tuned TPU sort runs at ~160M
+     rows/s (measured v5e), where the round-1 scatter hash table
+     managed ~3MB/s end to end.
   3. Run boundaries by shifted compare; per-run reduction by an unrolled
      segmented scan (any monoid) or run-length count; run ends compacted
      by searchsorted+gather (ops/segscan.py).  Zero record-granularity
      scatters anywhere.
   4. One ``partition_exchange`` (all_to_all over ICI) of the device's
-     UNIQUE records only; a final small sorted-unique pass per partition.
+     UNIQUE records only — carrying the RUNNING ACCUMULATOR (the
+     per-partition uniques of the waves already folded, threaded into
+     the program as donated arguments) — then a final sorted-unique
+     pass that merges exchange rows AND accumulator in the same sort.
+     Each wave is therefore map→sort→exchange→fold in a single ``jit``
+     dispatch: no separate merge program, no per-wave concatenate
+     copies, no per-wave merge-overflow readbacks, and the donated
+     buffers free HBM the moment the program consumes them.
 
 All capacities are static; overflows are *counted* and surfaced, and
 :meth:`DeviceEngine.run` retries with capacities RIGHT-SIZED from the
@@ -52,7 +68,7 @@ from ..obs import profile as _profile
 from ..obs.trace import TRACER
 from ..ops.segscan import SENTINEL, sorted_unique_reduce
 from ..parallel.shuffle import partition_exchange
-from ..utils.jax_compat import pcast, shard_map
+from ..utils.jax_compat import pcast, quiet_unusable_donation, shard_map
 
 AXIS = "data"
 
@@ -61,6 +77,12 @@ AXIS = "data"
 #    (LATENCY_BUCKETS' 1ms floor collapses sub-millisecond waves) -----------
 _WAVES = _obs.counter("mrtpu_device_waves_total",
                       "device-engine waves executed")
+_DISPATCHES = _obs.counter(
+    "mrtpu_device_dispatches_total",
+    "compiled programs dispatched by the device engine (labels: "
+    "program; the fused engine issues exactly one program=wave dispatch "
+    "per wave — a nonzero program=merge count would mean the deleted "
+    "two-dispatch path came back)")
 _RETRIES = _obs.counter("mrtpu_device_retries_total",
                         "capacity-overflow recompile retries")
 _STAGE_SECONDS = _obs.counter(
@@ -85,6 +107,21 @@ class EngineConfig:
     tile_records: int = 128           # record slots per tile (map side)
     reduce_op: Union[str, Callable] = "sum"
     unit_values: bool = False         # values are all 1: count runs instead
+    #: on-device combiner: pre-reduce each chunk's records inside the
+    #: map scan (sort + run-combine at chunk scale) before they enter
+    #: the device-wide buffer — valid ONLY because reduce_op declares an
+    #: ACI monoid (the compiler-visible reducefn.lua flags); shrinks the
+    #: big-sort row count on duplicate-heavy workloads.  Off by default;
+    #: the wordcount engine turns it on.
+    combine_in_scan: bool = False
+    #: record slots the combiner compacts one chunk into (0 = auto:
+    #: T//4 floored at 256, clamped to T); per-chunk uniques beyond it
+    #: are counted as overflow and right-sized by the retry loop
+    combine_capacity: int = 0
+    #: rank-sort (sort [k1,k2,iota] only, permute lanes by gather);
+    #: False restores the variadic all-lanes sort — kept for the
+    #: golden-equivalence suite, not for production use
+    rank_sort: bool = True
 
     def cache_key(self):
         # the op object itself is part of the key: keeping it in the
@@ -92,7 +129,28 @@ class EngineConfig:
         # lambda's id can never be reused to hit a stale program
         return (self.local_capacity, self.exchange_capacity,
                 self.out_capacity, self.tile, self.tile_records,
-                self.reduce_op, self.unit_values)
+                self.reduce_op, self.unit_values, self.combine_in_scan,
+                self.combine_capacity, self.rank_sort)
+
+    def scan_combine_slots(self, T: int) -> int:
+        """Static buffer slots one chunk's pre-reduced records occupy
+        when the combiner is on, clamped to [1, T] (at T the combiner
+        degenerates to a per-chunk dedup — still correct)."""
+        cap = self.combine_capacity or max(T // 4, 256)
+        return max(1, min(T, cap))
+
+
+def _stage_ops(cfg: EngineConfig):
+    """``(local_op, local_unit, fin_op)`` — the per-stage reduce algebra.
+    With the in-scan combiner on, buffer rows are already per-chunk
+    partial reductions, so the local stage must COMBINE them (unit-value
+    run counts combine by sum) instead of counting rows again."""
+    if cfg.combine_in_scan and cfg.unit_values:
+        local_op, local_unit = "sum", False
+    else:
+        local_op, local_unit = cfg.reduce_op, cfg.unit_values
+    fin_op = "sum" if cfg.unit_values else cfg.reduce_op
+    return local_op, local_unit, fin_op
 
 
 class DeviceResult(NamedTuple):
@@ -168,10 +226,13 @@ class _WaveFeeder:
         chunks = self._chunks
         if lo + self.rpw <= self.S:
             block = chunks[lo:lo + self.rpw]  # zero-copy view
-        else:  # final wave: pad with zero chunks (masked via n_real)
-            block = np.zeros((self.rpw,) + chunks.shape[1:],
-                             dtype=chunks.dtype)
-            block[:self.S - lo] = chunks[lo:]
+        else:  # final wave: pad with zero chunks (masked via n_real) —
+            # allocating and zeroing ONLY the pad rows; the real rows
+            # ride the concatenate's single copy instead of a full
+            # wave-sized zero fill plus a second copy over it
+            pad = np.zeros((lo + self.rpw - self.S,) + chunks.shape[1:],
+                           dtype=chunks.dtype)
+            block = np.concatenate([chunks[lo:], pad])
         dev_chunks = jax.device_put(block, self._sharding)
         idx = np.arange(lo, lo + self.rpw, dtype=np.int32)
         dev_idx = jax.device_put(idx, self._sharding)
@@ -246,36 +307,77 @@ class DeviceEngine:
 
     def _program(self, cfg: EngineConfig):
         map_fn = self.map_fn
+        local_op, local_unit, fin_op = _stage_ops(cfg)
 
         def per_device(chunks: jax.Array, chunk_idx: jax.Array,
-                       n_real: jax.Array):
+                       n_real: jax.Array, acc_k: jax.Array,
+                       acc_v: jax.Array, acc_p: jax.Array,
+                       acc_valid: jax.Array):
             # chunks: [k, ...chunk_shape], chunk_idx: [k] global indices,
             # n_real: [] count of genuine chunks — indices >= n_real are
             # padding added to even out the mesh; their records (and any
-            # overflow they report) are masked out after map_fn
+            # overflow they report) are masked out after map_fn.
+            # acc_*: [1, out_capacity, ...] — the RUNNING per-partition
+            # uniques of the waves already folded (all-invalid on the
+            # first wave), threaded through as donated inputs so the
+            # whole wave is one dispatch and the accumulator buffers are
+            # updated in place
             k = chunks.shape[0]
             keys0, vals0, pay0, valid0, _ = map_fn(chunks[0], chunk_idx[0],
                                                    cfg)
             T = keys0.shape[0]
             Q = pay0.shape[1]
-            N = k * T
+            combine = cfg.combine_in_scan
+            Tc = cfg.scan_combine_slots(T) if combine else T
+            N = k * Tc
+
+            # buffer row avals: the combiner changes the per-chunk slot
+            # count and (for unit_values) the value lane to int32 counts
+            if combine:
+                cu0 = jax.eval_shape(
+                    lambda kk, vv, pp, mm: sorted_unique_reduce(
+                        kk, vv, pp, mm, Tc, cfg.reduce_op,
+                        unit_values=cfg.unit_values,
+                        rank_sort=cfg.rank_sort),
+                    keys0, vals0, pay0, valid0)
+                v_shape, v_dtype = cu0.values.shape[1:], cu0.values.dtype
+            else:
+                v_shape, v_dtype = vals0.shape[1:], vals0.dtype
 
             def varying(a):
                 return pcast(a, AXIS, to="varying")
 
-            # phase 1: map + append into the device-resident record buffer
+            # phase 1: map (+ optional combine) + append into the
+            # device-resident record buffer
             buf_k = varying(jnp.full((N, 2), SENTINEL, jnp.uint32))
-            buf_v = varying(jnp.zeros((N,) + vals0.shape[1:], vals0.dtype))
+            buf_v = varying(jnp.zeros((N,) + v_shape, v_dtype))
             buf_p = varying(jnp.zeros((N, Q), pay0.dtype))
-            oflow0 = varying(jnp.int32(0))
+            zero0 = varying(jnp.int32(0))
 
             def step(state, xs):
-                buf_k, buf_v, buf_p, oflow = state
+                buf_k, buf_v, buf_p, map_oflow, comb_oflow, comb_max = state
                 chunk, idx, j = xs
-                keys, vals, pay, valid, map_oflow = map_fn(chunk, idx, cfg)
+                keys, vals, pay, valid, m_oflow = map_fn(chunk, idx, cfg)
                 live = idx < n_real
                 valid = valid & live
-                map_oflow = jnp.where(live, map_oflow, 0)
+                map_oflow = map_oflow + jnp.where(live, m_oflow, 0)
+                if combine:
+                    # the on-device combiner: the declared ACI monoid
+                    # licenses partial reduction at any grouping
+                    # (reducefn.lua:10-14 / job.lua:264-284 do the same
+                    # check dynamically), so the chunk's duplicates are
+                    # folded HERE — a chunk-scale sort + shifted-compare
+                    # run-combine — and the big sort sees Tc rows per
+                    # chunk instead of T
+                    cu = sorted_unique_reduce(
+                        keys, vals, pay, valid, Tc, cfg.reduce_op,
+                        unit_values=cfg.unit_values,
+                        rank_sort=cfg.rank_sort)
+                    keys, vals, pay, valid = (cu.keys, cu.values,
+                                              cu.payload, cu.valid)
+                    comb_oflow = comb_oflow + jnp.maximum(
+                        cu.n_unique - Tc, 0)
+                    comb_max = jnp.maximum(comb_max, cu.n_unique)
                 # a VALID record whose key is literally the sentinel pair
                 # is remapped to (0,0) — matching sorted_unique_reduce's
                 # remap — so buf_valid below cannot mistake it for padding
@@ -286,52 +388,61 @@ class DeviceEngine:
                 keys = jnp.where(is_sent[:, None], jnp.uint32(0), keys)
                 # invalid rows -> sentinel keys (sort to the end)
                 kk = jnp.where(valid[:, None], keys, SENTINEL)
-                buf_k = jax.lax.dynamic_update_slice(buf_k, kk, (j * T, 0))
+                buf_k = jax.lax.dynamic_update_slice(buf_k, kk, (j * Tc, 0))
                 buf_v = jax.lax.dynamic_update_slice(
-                    buf_v, vals, (j * T,) + (0,) * (buf_v.ndim - 1))
-                buf_p = jax.lax.dynamic_update_slice(buf_p, pay, (j * T, 0))
-                return (buf_k, buf_v, buf_p, oflow + map_oflow), None
+                    buf_v, vals, (j * Tc,) + (0,) * (buf_v.ndim - 1))
+                buf_p = jax.lax.dynamic_update_slice(buf_p, pay,
+                                                     (j * Tc, 0))
+                return (buf_k, buf_v, buf_p, map_oflow, comb_oflow,
+                        comb_max), None
 
-            (buf_k, buf_v, buf_p, map_oflow), _ = jax.lax.scan(
-                step, (buf_k, buf_v, buf_p, oflow0),
-                (chunks, chunk_idx, jnp.arange(k, dtype=jnp.int32)))
+            (buf_k, buf_v, buf_p, map_oflow, comb_oflow, comb_max), _ = \
+                jax.lax.scan(
+                    step, (buf_k, buf_v, buf_p, zero0, zero0, zero0),
+                    (chunks, chunk_idx, jnp.arange(k, dtype=jnp.int32)))
 
-            # phases 2+3: one big sort, segmented reduce, gather-compact
+            # phases 2+3: one big rank-sort, segmented reduce, compact
             buf_valid = ~((buf_k[:, 0] == SENTINEL)
                           & (buf_k[:, 1] == SENTINEL))
             local = sorted_unique_reduce(
                 buf_k, buf_v, buf_p, buf_valid, cfg.local_capacity,
-                cfg.reduce_op, unit_values=cfg.unit_values)
-            local_oflow = (map_oflow
+                local_op, unit_values=local_unit, rank_sort=cfg.rank_sort)
+            local_oflow = (map_oflow + comb_oflow
                            + jnp.maximum(local.n_unique
                                          - cfg.local_capacity, 0))
 
-            # phase 4: shuffle uniques to their partition over ICI
+            # phase 4: shuffle uniques to their partition over ICI, the
+            # accumulator riding along as the exchange's carry spec
+            # (prepended, so the stable fold order stays acc ⊕ wave) —
+            # the final sorted-unique pass then merges the fresh rows
+            # WITH the running uniques in one sort, replacing the old
+            # separate merge dispatch and its concatenate copies
             ex = partition_exchange(local.keys, local.values, local.payload,
                                     local.valid, AXIS,
-                                    cfg.exchange_capacity)
+                                    cfg.exchange_capacity,
+                                    carry=(acc_k[0], acc_v[0], acc_p[0],
+                                           acc_valid[0]))
 
-            # final per-partition merge of the P devices' partial uniques
-            # (partial reductions combine with the same monoid; unit-value
-            # counts combine by sum)
-            fin_op = "sum" if cfg.unit_values else cfg.reduce_op
             fin = sorted_unique_reduce(
                 ex.keys, ex.values, ex.payload, ex.valid, cfg.out_capacity,
-                fin_op, unit_values=False)
+                fin_op, unit_values=False, rank_sort=cfg.rank_sort)
             fin_oflow = jnp.maximum(fin.n_unique - cfg.out_capacity, 0)
 
             # LOCAL overflow per device — the host sums across devices
-            # (a psum here would get double-counted by that host sum)
+            # (a psum here would get double-counted by that host sum).
+            # The fold's overflow is fin_oflow: it lands here, in the
+            # same per-wave overflow lane the readback already fetches.
             local_oflow = local_oflow + ex.overflow + fin_oflow
             # capacity NEEDS per device, so a retry can jump straight to
             # right-sized capacities instead of blind doubling (each lane
             # is a lower bound if an earlier stage truncated, so the
             # retry loop still iterates — but converges in one or two
             # right-sized compiles):
-            # [local uniques, exchange per-dest max, final uniques,
-            #  map-stage drops]
+            # [local uniques, exchange per-dest max, final uniques
+            #  (cumulative: the accumulator is folded in), map-stage
+            #  drops, combiner per-chunk unique max]
             needs = jnp.stack([local.n_unique, ex.max_count,
-                               fin.n_unique, map_oflow])
+                               fin.n_unique, map_oflow, comb_max])
             # keep leading device axis for the host: [1, ...] per shard
             expand = lambda a: a[None]
             return (expand(fin.keys), expand(fin.values),
@@ -341,10 +452,15 @@ class DeviceEngine:
         sharded = P(AXIS)
         fn = shard_map(
             per_device, mesh=self.mesh,
-            in_specs=(sharded, sharded, P()),
+            in_specs=(sharded, sharded, P(), sharded, sharded, sharded,
+                      sharded),
             out_specs=(sharded,) * 6,
         )
-        return jax.jit(fn)
+        # donate the accumulator (its buffers alias the fin outputs —
+        # the fold updates it in place) AND the wave inputs (HBM freed
+        # the moment the program consumes them, no explicit del dance);
+        # n_real is reused by every wave and stays undonated
+        return jax.jit(fn, donate_argnums=(0, 1, 3, 4, 5, 6))
 
     def _get_compiled(self, cfg: EngineConfig):
         key = cfg.cache_key()
@@ -352,34 +468,63 @@ class DeviceEngine:
             self._compiled[key] = self._program(cfg)
         return self._compiled[key]
 
-    def _merge_program(self, cfg: EngineConfig):
-        """Program that folds W waves' per-partition uniques into one:
-        the inputs are the concatenated wave outputs ([n_dev, W*C, ...]),
-        and each device re-reduces its own partition's W partial unique
-        sets with the final monoid — no collective needed, because wave
-        outputs for partition p already live on device p."""
-        fin_op = "sum" if cfg.unit_values else cfg.reduce_op
-        C = cfg.out_capacity
-
-        def merge_dev(keys, vals, pay, valid):
-            fin = sorted_unique_reduce(keys[0], vals[0], pay[0], valid[0],
-                                       C, fin_op, unit_values=False)
-            oflow = jnp.maximum(fin.n_unique - C, 0)
-            expand = lambda a: a[None]
-            return (expand(fin.keys), expand(fin.values),
-                    expand(fin.payload), expand(fin.valid), expand(oflow))
-
-        sharded = P(AXIS)
-        fn = shard_map(merge_dev, mesh=self.mesh,
-                           in_specs=(sharded,) * 4,
-                           out_specs=(sharded,) * 5)
-        return jax.jit(fn)
-
-    def _get_merge(self, cfg: EngineConfig):
-        key = ("merge",) + cfg.cache_key()
+    def _fin_row_avals(self, cfg: EngineConfig, row_shape, row_dtype):
+        """Per-partition accumulator row avals — ``[(C,2) u32 keys,
+        (C,...) values, (C,Q) payload, (C,) valid]`` — for the fused
+        fold, derived by shape-tracing map_fn → (combiner) → local →
+        fin exactly as the program computes them, so value-dtype
+        promotion through a custom monoid is honoured.  Cached per
+        (cfg, row aval)."""
+        key = ("acc_aval", cfg.cache_key(), tuple(row_shape),
+               str(np.dtype(row_dtype)))
         if key not in self._compiled:
-            self._compiled[key] = self._merge_program(cfg)
+            local_op, local_unit, fin_op = _stage_ops(cfg)
+
+            def probe(chunk, ci):
+                keys, vals, pay, valid, _ = self.map_fn(chunk, ci, cfg)
+                if cfg.combine_in_scan:
+                    cu = sorted_unique_reduce(
+                        keys, vals, pay, valid, 8, cfg.reduce_op,
+                        unit_values=cfg.unit_values)
+                    keys, vals, pay, valid = (cu.keys, cu.values,
+                                              cu.payload, cu.valid)
+                local = sorted_unique_reduce(keys, vals, pay, valid, 8,
+                                             local_op,
+                                             unit_values=local_unit)
+                return sorted_unique_reduce(
+                    local.keys, local.values, local.payload, local.valid,
+                    8, fin_op, unit_values=False)
+
+            row = jax.ShapeDtypeStruct(tuple(row_shape), row_dtype)
+            idx = jax.ShapeDtypeStruct((), np.int32)
+            fin = jax.eval_shape(probe, row, idx)
+            C = cfg.out_capacity
+            self._compiled[key] = (
+                jax.ShapeDtypeStruct((C, 2), np.uint32),
+                jax.ShapeDtypeStruct((C,) + tuple(fin.values.shape[1:]),
+                                     fin.values.dtype),
+                jax.ShapeDtypeStruct((C,) + tuple(fin.payload.shape[1:]),
+                                     fin.payload.dtype),
+                jax.ShapeDtypeStruct((C,), np.bool_),
+            )
         return self._compiled[key]
+
+    def _acc_init(self, cfg: EngineConfig, row_shape, row_dtype):
+        """Fresh all-invalid accumulator ``[n_dev, C, ...]`` arrays for
+        an attempt — built ON DEVICE by a cached zeros program with the
+        run's shardings (never a multi-megabyte host transfer of zeros
+        over the slow link)."""
+        avals = self._fin_row_avals(cfg, row_shape, row_dtype)
+        key = ("acc_init", cfg.cache_key(),
+               tuple((a.shape, str(a.dtype)) for a in avals))
+        if key not in self._compiled:
+            sh = NamedSharding(self.mesh, P(AXIS))
+            n_dev = self.n_dev
+            self._compiled[key] = jax.jit(
+                lambda: tuple(jnp.zeros((n_dev,) + a.shape, a.dtype)
+                              for a in avals),
+                out_shardings=(sh,) * 4)
+        return list(self._compiled[key]())
 
     # -- host driver -------------------------------------------------------
 
@@ -440,8 +585,9 @@ class DeviceEngine:
     def _max_inflight_programs(self) -> int:
         """Wave programs allowed in the dispatch queue before the driver
         blocks on an older wave's completion.  On TPU the per-device queue
-        executes serially and a modest depth keeps dispatch pipelined (and
-        bounds the output buffers of un-folded waves).  On the CPU backend
+        executes serially and a modest depth keeps dispatch pipelined
+        (the fused fold chains each wave through the donated accumulator,
+        so queued waves hold only their input buffers).  On the CPU backend
         every queued shard occupies a thread-pool worker, so shards of
         later waves can starve an earlier wave's all_to_all rendezvous of
         its participants — a deadlock XLA aborts after 40s; strict
@@ -458,21 +604,24 @@ class DeviceEngine:
     def _resize(self, cfg: EngineConfig, need_arrays) -> EngineConfig:
         """Right-size capacities from the failed run's measured needs
         (program output lane 5: [local uniques, exchange per-dest max,
-        final uniques, map drops] per device) — one informed recompile
-        instead of blind doubling (SURVEY §7(a) count-then-size, done as
-        measure-then-size on the run we already paid for).  Needs are
-        lower bounds when an earlier stage truncated, so the loop may
-        take a second sizing pass; it never regresses a capacity."""
+        final uniques, map drops, combiner per-chunk max] per device) —
+        one informed recompile instead of blind doubling (SURVEY §7(a)
+        count-then-size, done as measure-then-size on the run we already
+        paid for).  Needs are lower bounds when an earlier stage
+        truncated, so the loop may take a second sizing pass; it never
+        regresses a capacity."""
         hosted = self._host(*need_arrays)  # one batched gather
         needs = np.stack(hosted if len(need_arrays) > 1 else [hosted])
-        # [W, dev, 4]
+        # [W, dev, 5]
         local_need = int(needs[:, :, 0].max())
         ex_need = int(needs[:, :, 1].max())
-        # per-partition union across waves is bounded by the sum of the
-        # waves' unique counts
-        fin_need = int(needs[:, :, 2].sum(axis=0).max())
+        # the fused fold's fin count is CUMULATIVE (the accumulator is
+        # folded into every wave's final pass), so the max across waves
+        # is already the per-partition union bound
+        fin_need = int(needs[:, :, 2].max())
         map_dropped = int(needs[:, :, 3].sum())
-        return replace(
+        comb_need = int(needs[:, :, 4].max())
+        out = replace(
             cfg,
             local_capacity=max(cfg.local_capacity, self._fit(local_need)),
             exchange_capacity=max(cfg.exchange_capacity,
@@ -481,6 +630,13 @@ class DeviceEngine:
             tile_records=(min(cfg.tile_records * 2, cfg.tile)
                           if map_dropped else cfg.tile_records),
         )
+        if cfg.combine_in_scan and comb_need > 0:
+            # explicit combiner slots from the measured per-chunk unique
+            # max (scan_combine_slots clamps to T at trace time, where
+            # the combiner degenerates to a correct per-chunk dedup)
+            out = replace(out, combine_capacity=max(cfg.combine_capacity,
+                                                    self._fit(comb_need)))
+        return out
 
     # -- cost model (obs/profile.py: FLOPs/MFU accounting) ------------------
 
@@ -497,7 +653,9 @@ class DeviceEngine:
                tuple((tuple(s.shape), str(s.dtype)) for s in shapes))
         if key not in self._compiled:
             try:
-                compiled = self._get_compiled(cfg).lower(*shapes).compile()
+                with quiet_unusable_donation():
+                    compiled = self._get_compiled(cfg).lower(
+                        *shapes).compile()
                 costs = _profile.program_costs(compiled)
             except Exception:
                 costs = None  # fall through to the analytic estimate
@@ -537,21 +695,27 @@ class DeviceEngine:
             Q, val_bytes = 1, 4
         n_records = chunk_rows * T
         record_bytes = 8 + val_bytes + 4 * Q + 1  # key + value + payload
+        # the fused fold re-sorts the accumulator rows (out_capacity
+        # running uniques) into every wave's final merge pass
         return _profile.analytic_costs(input_bytes, n_records,
-                                       record_bytes)
+                                       record_bytes,
+                                       fold_records=cfg.out_capacity)
 
     def precompile(self, row_shape, row_dtype=np.uint8,
                    k: int = None) -> float:
-        """AOT-compile the per-wave program and the wave-merge program at
-        the AUTO wave shape for rows of *row_shape*, returning the
-        seconds spent.  With ``jax.config.jax_compilation_cache_dir``
-        set, this populates XLA's persistent cache — cold compile is
-        ~100s at bench shapes (the lax.sort comparator dominates;
-        scratch/prof_compile*.py) and the auto wave split is
-        corpus-size-independent, so one warmup serves every future corpus
-        on the machine.  (bench.py runs this synchronously after
-        staging — compile RPCs and corpus transfers share the tunnel,
-        so overlapping them just serialises both.)"""
+        """AOT-compile the fused per-wave program at the AUTO wave shape
+        for rows of *row_shape*, returning the seconds spent.  (There is
+        no separate merge program anymore — the wave fold is fused into
+        the one dispatch, so this primes the engine's entire compiled
+        surface.)  With ``jax.config.jax_compilation_cache_dir`` set,
+        this populates XLA's persistent cache — cold compile is ~100s at
+        bench shapes (the lax.sort comparator dominates, now decoupled
+        from record width by the rank-sort; scratch/prof_compile*.py)
+        and the auto wave split is corpus-size-independent, so one
+        warmup serves every future corpus on the machine.  (bench.py
+        runs this synchronously after staging — compile RPCs and corpus
+        transfers share the tunnel, so overlapping them just serialises
+        both.)"""
         import time
 
         t0 = time.monotonic()
@@ -571,17 +735,12 @@ class DeviceEngine:
             jax.ShapeDtypeStruct((k * self.n_dev,), np.int32,
                                  sharding=row_sh),
             jax.ShapeDtypeStruct((), np.int32, sharding=rep),
-        )
-        fn = self._get_compiled(cfg)
-        out_info = jax.eval_shape(fn, *shapes)
-        fn.lower(*shapes).compile()
-        # merge folds two per-partition unique sets: [n_dev, 2C, ...],
-        # sharded over the leading device axis like the wave outputs
-        merged = [jax.ShapeDtypeStruct(
-            (a.shape[0], 2 * a.shape[1]) + a.shape[2:], a.dtype,
-            sharding=NamedSharding(self.mesh, P(AXIS)))
-            for a in out_info[:4]]
-        self._get_merge(cfg).lower(*merged).compile()
+        ) + tuple(
+            jax.ShapeDtypeStruct((self.n_dev,) + a.shape, a.dtype,
+                                 sharding=row_sh)
+            for a in self._fin_row_avals(cfg, row_shape, row_dtype))
+        with quiet_unusable_donation():
+            self._get_compiled(cfg).lower(*shapes).compile()
         return time.monotonic() - t0
 
     def stage_inputs(self, chunks: np.ndarray, waves: int = None):
@@ -633,12 +792,12 @@ class DeviceEngine:
 
         *waves* (default: auto from input size) pipelines the host->device
         link against the TPU AND bounds device memory: each wave's input
-        is uploaded (at most STREAM_PREFETCH waves in flight), its
-        map/sort/shuffle program dispatched, its per-partition uniques
-        folded into the running result by an on-device merge, and its
-        input FREED — peak HBM is ~2 wave inputs + the accumulated
-        uniques, never the corpus (the reference's bounded-memory input
-        iterators, utils.lua:133-200, done for HBM).
+        is uploaded (at most STREAM_PREFETCH waves in flight), ONE fused
+        map/sort/shuffle/fold program dispatched (the running
+        per-partition uniques ride through it as donated arguments), and
+        its input FREED by that donation — peak HBM is ~2 wave inputs +
+        the accumulated uniques, never the corpus (the reference's
+        bounded-memory input iterators, utils.lua:133-200, done for HBM).
 
         Pass ``timings={}`` to receive per-stage wall seconds — the
         device-path analogue of the host server's per-phase stats
@@ -682,6 +841,8 @@ class DeviceEngine:
             # remember the handle's per-wave row split so a capacity
             # retry re-uploads at the SAME program shape (no recompile)
             staged_k = staged_list[0][0].shape[0] // self.n_dev
+            row_shape = tuple(staged_list[0][0].shape[1:])
+            row_dtype = staged_list[0][0].dtype
             # consume the handle: freeing below must work even while the
             # caller still holds it
             staged_list.clear()
@@ -695,6 +856,8 @@ class DeviceEngine:
                                      prefetch=self.STREAM_PREFETCH)
             W = feeder.waves  # clamped to data-bearing waves
             n_real = feeder.n_real
+            row_shape = tuple(chunks.shape[1:])
+            row_dtype = chunks.dtype
 
         t_upload = 0.0
         t_compute = 0.0
@@ -705,11 +868,19 @@ class DeviceEngine:
             depth = self._max_inflight_programs()
             for attempt in range(max_retries + 1):
                 fn = self._get_compiled(cfg)
-                merge = self._get_merge(cfg) if W > 1 else None
+                # fresh all-invalid accumulator per attempt (capacities
+                # may have grown; the prior attempt's buffers were
+                # donated away wave by wave).  cost_shapes resets with
+                # it: the accumulator avals are sized by the attempt's
+                # cfg, so the cost model must see the FINAL attempt's
+                # shapes — lowering the resized program against a stale
+                # attempt's avals would miss the executable cache (a
+                # fresh ~100s compile at bench shapes) and record costs
+                # for a program that never ran.
+                acc = self._acc_init(cfg, row_shape, row_dtype)
+                cost_shapes = None
                 t0 = time.monotonic()
                 t_blocked = 0.0
-                acc = None
-                merge_oflows = []
                 wave_oflows = []
                 wave_oflow_vals = {}
                 need_arrays = []
@@ -743,79 +914,80 @@ class DeviceEngine:
                     _WAVE_SECONDS.observe(tr1 - tr0, stage="readback")
 
                 try:
-                    for w in range(W):
-                        tb = time.monotonic()
-                        wave_spans[w] = TRACER.begin("wave", parent=run_sp,
-                                                     start=tb, wave=w)
-                        if pairs is not None:
-                            ci, ii = pairs[w]
-                        else:
-                            ci, ii = feeder.get(w)
-                        # wave w's program must not queue against an
-                        # in-flight transfer (measured to throttle the
-                        # tunnelled link); the wait is charged to upload
-                        jax.block_until_ready(ci)
-                        t_up = time.monotonic()
-                        TRACER.end(TRACER.begin("upload",
-                                                parent=wave_spans[w],
-                                                start=tb), t_up)
-                        _WAVE_SECONDS.observe(t_up - tb, stage="upload")
-                        t_blocked += t_up - tb
-                        if w >= depth:
-                            # bound the dispatch queue via a VALUE
-                            # readback: on the tunnelled platform
-                            # block_until_ready on a small array can
-                            # return before execution finishes
-                            # (measured), which would quietly void both
-                            # the HBM bound and the CPU rendezvous
-                            # serialization
-                            _read_wave_oflow(w - depth)
-                        tc0 = time.monotonic()
-                        out = fn(ci, ii, n_real)
-                        if cost_shapes is None:
-                            cost_shapes = tuple(
-                                jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                                     sharding=a.sharding)
-                                for a in (ci, ii, n_real))
-                        wave_oflows.append(out[4])
-                        need_arrays.append(out[5])
-                        if acc is None:
-                            acc = out[:4]
-                        else:
-                            # fold wave w into the running uniques (2C
-                            # rows — shape-stable, so ONE merge compile
-                            # serves any W)
-                            merged = merge(
-                                *(jnp.concatenate([acc[i], out[i]],
-                                                  axis=1)
-                                  for i in range(4)))
-                            acc = merged[:4]
-                            merge_oflows.append(merged[4])
-                        tc1 = time.monotonic()
-                        TRACER.end(TRACER.begin("compute",
-                                                parent=wave_spans[w],
-                                                start=tc0,
-                                                async_dispatch=True),
-                                   tc1)
-                        _WAVE_SECONDS.observe(tc1 - tc0, stage="compute")
-                        del out
-                        # wave w is consumed: drop its input references
-                        # so the HBM frees the moment its program
-                        # completes
-                        if pairs is not None:
-                            pairs.pop(w, None)
-                        else:
-                            feeder.release(w)
-                        del ci, ii
+                    # ONE scoped unusable-donation filter per attempt
+                    # (the expected warning fires at lowering — at
+                    # most the attempt's first wave — and entering
+                    # catch_warnings once per attempt instead of per
+                    # dispatch minimises global filter churn)
+                    with quiet_unusable_donation():
+                        for w in range(W):
+                            tb = time.monotonic()
+                            wave_spans[w] = TRACER.begin("wave", parent=run_sp,
+                                                         start=tb, wave=w)
+                            if pairs is not None:
+                                ci, ii = pairs[w]
+                            else:
+                                ci, ii = feeder.get(w)
+                            # wave w's program must not queue against an
+                            # in-flight transfer (measured to throttle the
+                            # tunnelled link); the wait is charged to upload
+                            jax.block_until_ready(ci)
+                            t_up = time.monotonic()
+                            TRACER.end(TRACER.begin("upload",
+                                                    parent=wave_spans[w],
+                                                    start=tb), t_up)
+                            _WAVE_SECONDS.observe(t_up - tb, stage="upload")
+                            t_blocked += t_up - tb
+                            if w >= depth:
+                                # bound the dispatch queue via a VALUE
+                                # readback: on the tunnelled platform
+                                # block_until_ready on a small array can
+                                # return before execution finishes
+                                # (measured), which would quietly void both
+                                # the HBM bound and the CPU rendezvous
+                                # serialization
+                                _read_wave_oflow(w - depth)
+                            tc0 = time.monotonic()
+                            if cost_shapes is None:
+                                # capture BEFORE the dispatch: donation
+                                # invalidates the inputs at call time
+                                cost_shapes = tuple(
+                                    jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                         sharding=a.sharding)
+                                    for a in (ci, ii, n_real, *acc))
+                            # ONE dispatch per wave: map→sort→exchange→fold,
+                            # the running uniques threaded through as
+                            # donated args (out[:4] reuse their buffers)
+                            out = fn(ci, ii, n_real, *acc)
+                            _DISPATCHES.inc(1, program="wave")
+                            wave_oflows.append(out[4])
+                            need_arrays.append(out[5])
+                            acc = list(out[:4])
+                            tc1 = time.monotonic()
+                            TRACER.end(TRACER.begin("compute",
+                                                    parent=wave_spans[w],
+                                                    start=tc0,
+                                                    async_dispatch=True),
+                                       tc1)
+                            _WAVE_SECONDS.observe(tc1 - tc0, stage="compute")
+                            del out
+                            # wave w is consumed: drop its input references
+                            # so the HBM frees the moment its program
+                            # completes
+                            if pairs is not None:
+                                pairs.pop(w, None)
+                            else:
+                                feeder.release(w)
+                            del ci, ii
                     keys, vals, pay, valid = acc
                     # the (tiny) overflow readbacks force program
-                    # completion — and close each wave's span
+                    # completion — and close each wave's span.  The
+                    # fold's overflow is already inside each wave's
+                    # lane: there are NO separate merge readbacks.
                     for w in range(W):
                         if w not in wave_oflow_vals:
                             _read_wave_oflow(w)
-                    total_oflow = (sum(wave_oflow_vals.values())
-                                   + sum(int(self._host(o).sum())
-                                         for o in merge_oflows))
+                    total_oflow = sum(wave_oflow_vals.values())
                 finally:
                     # a failed attempt must not leak open wave spans
                     # into the next attempt's timeline

@@ -97,11 +97,18 @@ def bench_engine_config() -> EngineConfig:
     ``warmup`` CLI must agree bit-for-bit for the persistent compilation
     cache to hit).  tile_records 104: ~25% headroom over the ~83 words
     per 512-byte tile of natural text, and measurably less sort work
-    than 128's half-empty record slots (scratch/prof_tune.py)."""
+    than 128's half-empty record slots (scratch/prof_tune.py).
+    combine_in_scan: natural text is duplicate-heavy (a 4MB chunk holds
+    ~850K running words but well under 100K uniques), so the in-scan
+    combiner shrinks the device-wide sort ~4x; combine_capacity 1<<17
+    (~131K slots per chunk) clears any natural-language vocabulary with
+    headroom while keeping the wave program shape fixed."""
     return EngineConfig(local_capacity=1 << 18,
                         exchange_capacity=1 << 17,
                         out_capacity=1 << 18,
-                        tile=512, tile_records=104)
+                        tile=512, tile_records=104,
+                        combine_in_scan=True,
+                        combine_capacity=1 << 17)
 
 
 class DeviceWordCount:
@@ -124,9 +131,14 @@ class DeviceWordCount:
         self.mesh = mesh
         self.chunk_len = chunk_len
         self.verify_collisions = verify_collisions
+        # the default config runs the on-device combiner: wordcount is
+        # the duplicate-heavy workload it exists for (counting IS an ACI
+        # monoid), and the per-chunk pre-reduce shrinks the device-wide
+        # sort.  An explicit *config* keeps full control (tests exercise
+        # both paths).
         cfg = config or EngineConfig(
             local_capacity=1 << 17, exchange_capacity=1 << 15,
-            out_capacity=1 << 17)
+            out_capacity=1 << 17, combine_in_scan=True)
         from dataclasses import replace
         if verify_collisions:
             # carry [count, h3, h3] value lanes reduced with

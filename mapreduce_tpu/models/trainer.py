@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.jax_compat import quiet_unusable_donation
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -114,7 +116,13 @@ class DistributedTrainer:
                 body, (params, opt_state), (xs, ys))
             return params, opt_state, losses
 
-        self._train_epoch = jax.jit(train_epoch, donate_argnums=(0, 1))
+        # donate the stacked epoch batches too (args 2, 3): a full
+        # epoch's xs/ys HBM is marked reusable while the scan runs (the
+        # lowered module tags them jax.buffer_donor) and the caller-side
+        # arrays are consumed — fit() device_puts fresh stacks each
+        # epoch anyway, so nothing legitimate reads them back
+        self._train_epoch = jax.jit(train_epoch,
+                                    donate_argnums=(0, 1, 2, 3))
         self.epoch_sharding = NamedSharding(mesh, P(None, "data"))
         self._eval = jax.jit(
             lambda p, x, y: loss_and_accuracy(p, x, y, self.mlp_cfg))
@@ -171,8 +179,11 @@ class DistributedTrainer:
                 self.epoch_sharding)
             ys = jax.device_put(y_tr[sel].reshape(steps, global_batch),
                                 self.epoch_sharding)
-            params, opt_state, losses = self._train_epoch(
-                params, opt_state, xs, ys)
+            # scoped: the stacked-batch donation is expected to be
+            # unaliasable (outputs are params/opt leaves and losses)
+            with quiet_unusable_donation():
+                params, opt_state, losses = self._train_epoch(
+                    params, opt_state, xs, ys)
             val_loss, val_acc = self._eval(params, x_va_d, y_va_d)
             val_loss = float(val_loss)
             rec = {"epoch": epoch,

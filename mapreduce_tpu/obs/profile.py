@@ -131,14 +131,19 @@ _SEGSCAN_FLOPS = 32
 
 
 def analytic_costs(input_bytes: int, n_records: int,
-                   record_bytes: int) -> Dict[str, float]:
+                   record_bytes: int,
+                   fold_records: int = 0) -> Dict[str, float]:
     """Rough cost of one engine wave when XLA's model is unavailable:
     the program is sort-dominated (device_engine.py module doc), so
     FLOPs ≈ records × log2(records) compare-exchanges + a linear
     segscan term, and bytes ≈ the input read plus one read+write of the
-    record buffer per sort pass.  An estimate with the right shape and
-    order of magnitude — labelled ``source="analytic"`` everywhere it
-    lands so nobody mistakes it for a measurement."""
+    record buffer per sort pass.  ``fold_records`` accounts for the
+    fused wave fold — the accumulator rows (``out_capacity`` running
+    uniques) re-sorted into the final per-partition merge every wave,
+    which the single-dispatch program pays in place of the old separate
+    merge dispatch.  An estimate with the right shape and order of
+    magnitude — labelled ``source="analytic"`` everywhere it lands so
+    nobody mistakes it for a measurement."""
     import math
 
     n = max(int(n_records), 1)
@@ -146,6 +151,12 @@ def analytic_costs(input_bytes: int, n_records: int,
     flops = float(n * passes * _SORT_CMP_FLOPS + n * _SEGSCAN_FLOPS)
     nbytes = float(max(int(input_bytes), 0)
                    + 2 * n * max(int(record_bytes), 1) * passes)
+    if fold_records > 0:
+        m = int(fold_records)
+        fold_passes = max(int(math.ceil(math.log2(m))), 1)
+        flops += float(m * fold_passes * _SORT_CMP_FLOPS
+                       + m * _SEGSCAN_FLOPS)
+        nbytes += float(2 * m * max(int(record_bytes), 1) * fold_passes)
     return {"flops": flops, "bytes": nbytes}
 
 

@@ -103,9 +103,10 @@ class SortedUnique(NamedTuple):
 
 def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
                          valid: jax.Array, capacity: int,
-                         op, unit_values: bool = False) -> SortedUnique:
-    """Group-by-key reduction for LARGE record batches: one variadic sort,
-    then shifted-compare run boundaries, a segmented scan (or run-length
+                         op, unit_values: bool = False,
+                         rank_sort: bool = True) -> SortedUnique:
+    """Group-by-key reduction for LARGE record batches: one sort, then
+    shifted-compare run boundaries, a segmented scan (or run-length
     count when ``unit_values``), and gather-based compaction of the run
     ends — the only scatter-free group-by that runs at sort speed on TPU.
 
@@ -113,6 +114,16 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
     "sum" / "min" / "max".  With ``unit_values=True`` the values operand
     is ignored and each key's result is its occurrence count (int32) —
     the wordcount fast path, which also drops a sort operand.
+
+    With ``rank_sort`` (the default) the sort carries only
+    ``[k1, k2, iota]`` — three lanes whatever the value/payload arity —
+    and the value/payload lanes are permuted afterwards by gathers.
+    This decouples the ``lax.sort`` comparator (whose cold compile
+    dominates the engine's ~100s compile at bench shapes and whose
+    runtime grows with every carried operand) from the record width.
+    ``lax.sort`` is stable, so the rank permutation reorders the lanes
+    bit-identically to the variadic sort; ``rank_sort=False`` keeps the
+    old variadic path for the golden-equivalence suite.
     """
     if isinstance(op, str):
         try:
@@ -130,17 +141,27 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
     k2 = jnp.where(valid, k2, SENTINEL)
 
     Q = payload.shape[1]
-    pay_lanes = [payload[:, i] for i in range(Q)]
     if unit_values:
-        val_lanes = []
+        v2 = None
+        n_val_lanes = 0
     else:
         v2 = values if values.ndim == 2 else values[:, None]
-        val_lanes = [v2[:, i] for i in range(v2.shape[1])]
-    sorted_ops = jax.lax.sort(tuple([k1, k2] + val_lanes + pay_lanes),
-                              num_keys=2)
-    k1s, k2s = sorted_ops[0], sorted_ops[1]
-    vals_s = list(sorted_ops[2:2 + len(val_lanes)])
-    pays_s = list(sorted_ops[2 + len(val_lanes):])
+        n_val_lanes = v2.shape[1]
+    if rank_sort:
+        iota = jnp.arange(N, dtype=jnp.int32)
+        k1s, k2s, perm = jax.lax.sort((k1, k2, iota), num_keys=2)
+        v2s = v2[perm] if n_val_lanes else None
+        vals_s = [v2s[:, i] for i in range(n_val_lanes)]
+        pay_s = payload[perm]
+        pays_s = [pay_s[:, i] for i in range(Q)]
+    else:
+        pay_lanes = [payload[:, i] for i in range(Q)]
+        val_lanes = [v2[:, i] for i in range(n_val_lanes)]
+        sorted_ops = jax.lax.sort(tuple([k1, k2] + val_lanes + pay_lanes),
+                                  num_keys=2)
+        k1s, k2s = sorted_ops[0], sorted_ops[1]
+        vals_s = list(sorted_ops[2:2 + len(val_lanes)])
+        pays_s = list(sorted_ops[2 + len(val_lanes):])
 
     row_valid = ~((k1s == SENTINEL) & (k2s == SENTINEL))
     prev1 = _shift_right(k1s, 1, 0)

@@ -17,17 +17,17 @@ C).  Packing is scatter-based (O(N)), not sort-based.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
 class Exchanged(NamedTuple):
-    keys: jax.Array      # [P*C, 2] uint32 — records received by this device
-    values: jax.Array    # [P*C, ...]
-    payload: jax.Array   # [P*C, Q] int32
-    valid: jax.Array     # [P*C] bool
+    keys: jax.Array      # [(A+)P*C, 2] uint32 — records received here
+    values: jax.Array    # [(A+)P*C, ...]
+    payload: jax.Array   # [(A+)P*C, Q] int32
+    valid: jax.Array     # [(A+)P*C] bool
     overflow: jax.Array  # [] int32 — rows dropped on the SEND side here
     max_count: jax.Array  # [] int32 — largest per-destination row count
     #                       BEFORE capping (what capacity SHOULD have been)
@@ -35,11 +35,22 @@ class Exchanged(NamedTuple):
 
 def partition_exchange(keys: jax.Array, values: jax.Array,
                        payload: jax.Array, valid: jax.Array,
-                       axis_name: str, capacity: int) -> Exchanged:
+                       axis_name: str, capacity: int,
+                       carry: Optional[Tuple] = None) -> Exchanged:
     """Exchange records so device ``p`` ends up with every record whose
     ``key_hi % P == p``.  Must run inside ``shard_map`` over *axis_name*.
 
     ``capacity`` bounds rows per (source, destination) pair.
+
+    ``carry`` is the accumulator-carrying spec for the fused wave fold:
+    an optional ``(keys [A,2], values [A,...], payload [A,Q],
+    valid [A])`` of rows ALREADY belonging to this device's partition
+    (the running per-partition uniques of earlier waves).  They are
+    prepended to the received rows — before, not after, so a stable
+    downstream sort keeps accumulator rows ahead of same-key wave rows
+    and the fold order stays ``acc ⊕ wave`` — letting the caller's
+    merge reduce accumulator + fresh records in ONE pass with no extra
+    dispatch or concatenate allocation outside the compiled program.
     """
     P = jax.lax.psum(1, axis_name)
     n = keys.shape[0]
@@ -74,11 +85,21 @@ def partition_exchange(keys: jax.Array, values: jax.Array,
     recv_live = jax.lax.all_to_all(send_live, axis_name, 0, 0, tiled=False)
 
     flat = lambda a: a.reshape((P * capacity,) + a.shape[2:])
+    out_keys = flat(recv_keys)
+    out_vals = flat(recv_vals)
+    out_pay = flat(recv_pay)
+    out_valid = flat(recv_live) == 1
+    if carry is not None:
+        ck, cv, cp, cvalid = carry
+        out_keys = jnp.concatenate([ck, out_keys], axis=0)
+        out_vals = jnp.concatenate([cv, out_vals], axis=0)
+        out_pay = jnp.concatenate([cp, out_pay], axis=0)
+        out_valid = jnp.concatenate([cvalid, out_valid], axis=0)
     return Exchanged(
-        keys=flat(recv_keys),
-        values=flat(recv_vals),
-        payload=flat(recv_pay),
-        valid=flat(recv_live) == 1,
+        keys=out_keys,
+        values=out_vals,
+        payload=out_pay,
+        valid=out_valid,
         overflow=overflow,
         max_count=counts.max().astype(jnp.int32),
     )

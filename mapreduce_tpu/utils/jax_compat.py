@@ -18,9 +18,16 @@ the engine:
   cast only stamps varying-manual-axes metadata for the vma
   replication checker, and pre-vma JAX tracks replication itself, so
   dropping it on those versions changes nothing about the computation.
+* :func:`quiet_unusable_donation` — the shared scoped filter for the
+  expected "donated buffers were not usable" warning at the two places
+  that donate inputs purely to free them (engine wave inputs, trainer
+  epoch batches).
 """
 
 from __future__ import annotations
+
+import contextlib
+import warnings
 
 import jax
 
@@ -43,3 +50,22 @@ else:
     def pcast(x, axis_name, to=None):  # noqa: ARG001 - signature parity
         """Identity on JAX versions without varying-manual-axes."""
         return x
+
+
+@contextlib.contextmanager
+def quiet_unusable_donation():
+    """Scoped suppression of jax's "Some donated buffers were not
+    usable" warning — the ONE shared helper for code that donates
+    buffers purely for their free-on-consumption semantics (the
+    engine's wave inputs, the trainer's stacked epoch batches), where
+    no output aliases them and the warning is expected once per
+    lowering.  Always a call-site context, never a process-wide filter
+    install, so a genuine donation failure anywhere else keeps its
+    diagnostic.  (``warnings.catch_warnings`` mutates global filter
+    state, so callers keep the scope to their own compile/dispatch
+    sites and enter it once per loop, not once per call, to minimise
+    the cross-thread window.)"""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message=r"Some donated buffers were not usable")
+        yield

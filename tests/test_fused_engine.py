@@ -1,0 +1,294 @@
+"""Golden-equivalence suite for the fused device hot loop.
+
+The perf PR rebuilt the per-wave program three ways — rank-sort,
+donated single-dispatch wave fold, on-device combiner — and every one
+of them must be INVISIBLE in results:
+
+* rank-sort vs the variadic path: ``lax.sort`` is stable, so sorting
+  ``[k1, k2, iota]`` and gathering the lanes must reproduce the
+  variadic all-lanes sort BIT-identically over randomized monoids,
+  lane counts, valid masks and capacities (including overflow);
+* fused fold vs the old merge: the deleted ``_merge_program`` is
+  reimplemented here as a host-side golden (per-partition
+  ``sorted_unique_reduce`` of ``[acc ∥ wave]`` — exactly what the old
+  two-dispatch path computed) and the fused multi-wave run must match
+  it bit-for-bit on integer monoids;
+* combiner on/off: identical results for wordcount and for a custom
+  ACI engine workload;
+* overflow/retry: absurd starting capacities (combiner slots included)
+  must converge to the same answer as generous ones;
+* the execution model itself: exactly one program dispatch per wave,
+  zero merge dispatches, and the wave inputs + accumulator declared
+  buffer donors in the lowering.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mapreduce_tpu.engine import DeviceEngine, DeviceWordCount, EngineConfig
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.ops.segscan import sorted_unique_reduce
+from mapreduce_tpu.parallel import make_mesh
+
+from tests.test_device_engine import _oracle, _random_text
+
+
+# -- rank-sort vs variadic ---------------------------------------------------
+
+def _stack_op(a, b):
+    """Custom ACI monoid over 3 lanes: sum, min, bitwise-or."""
+    return jnp.stack([a[..., 0] + b[..., 0],
+                      jnp.minimum(a[..., 1], b[..., 1]),
+                      jnp.bitwise_or(a[..., 2], b[..., 2])], axis=-1)
+
+
+#: (op, value lanes, unit_values) — 0 lanes = 1-D values array
+_RANK_CASES = [("sum", 0, False), ("min", 1, False), ("max", 2, False),
+               (_stack_op, 3, False), ("sum", 0, True)]
+
+
+@pytest.mark.parametrize("case", range(len(_RANK_CASES)))
+def test_rank_sort_bit_identical_to_variadic(case):
+    op, lanes, unit = _RANK_CASES[case]
+    rng = np.random.default_rng(100 + case)
+    for n, capacity in [(64, 32), (400, 512), (257, 64)]:
+        keys = rng.integers(0, 37, size=(n, 2)).astype(np.uint32)
+        valid = rng.random(n) < 0.8
+        pay = rng.integers(0, 1 << 30, size=(n, 2)).astype(np.int32)
+        shape = (n,) if lanes == 0 else (n, lanes)
+        vals = rng.integers(0, 1 << 20, size=shape).astype(np.int32)
+        outs = [sorted_unique_reduce(
+            jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(pay),
+            jnp.asarray(valid), capacity, op, unit_values=unit,
+            rank_sort=rs) for rs in (True, False)]
+        for field in range(5):
+            a = np.asarray(outs[0][field])
+            b = np.asarray(outs[1][field])
+            assert np.array_equal(a, b), (
+                f"case {case} n={n} cap={capacity} "
+                f"field {outs[0]._fields[field]} diverged")
+
+
+# -- engine fixtures ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _records_map_fn(chunk, chunk_index, cfg):
+    """Synthetic record stream derived from chunk DATA only (no
+    chunk_index dependence, so a per-wave slice run emits the same
+    records as the full run) with payload = f(key), making the run-end
+    representative independent of which occurrence survives."""
+    k1 = (chunk % 23).astype(jnp.uint32)
+    k2 = (chunk % 5).astype(jnp.uint32)
+    keys = jnp.stack([k1, k2], axis=-1)
+    vals = (chunk % 101).astype(jnp.int32) + 1
+    pay = (k1 * 7 + k2).astype(jnp.int32)[:, None]
+    valid = (chunk % 7) != 0
+    return keys, vals, pay, valid, jnp.int32(0)
+
+
+def _chunks(rng, s, r=32):
+    return rng.integers(0, 1 << 14, size=(s, r)).astype(np.int32)
+
+
+_NP_OPS = {"sum": lambda a, b: a + b, "min": min, "max": max,
+           "or": lambda a, b: a | b}
+
+
+def _dict_oracle(chunks, opname):
+    """Host reference reduction of _records_map_fn's record stream."""
+    op = _NP_OPS[opname]
+    out = {}
+    for row in chunks.reshape(-1):
+        if row % 7 == 0:
+            continue
+        key = (int(row % 23), int(row % 5))
+        v = int(row % 101) + 1
+        out[key] = op(out[key], v) if key in out else v
+    return out
+
+
+def _result_dict(res):
+    got = {}
+    for p in range(res.keys.shape[0]):
+        for i in range(res.keys.shape[1]):
+            if res.valid[p, i]:
+                key = (int(res.keys[p, i, 0]), int(res.keys[p, i, 1]))
+                assert key not in got, f"duplicate unique {key}"
+                got[key] = int(np.asarray(res.values[p, i]))
+    return got
+
+
+# -- fused fold vs the old two-dispatch merge --------------------------------
+
+def _old_merge_fold(acc, wave, out_capacity, fin_op):
+    """The deleted _merge_program as a host golden: per partition,
+    re-reduce the concatenation [accumulator ∥ wave uniques] with the
+    final monoid — accumulator rows FIRST, matching both the old
+    program's concatenate order and the fused carry's prepend."""
+    n_part = wave.keys.shape[0]
+    outs = []
+    for p in range(n_part):
+        fin = sorted_unique_reduce(
+            jnp.asarray(np.concatenate([acc["keys"][p], wave.keys[p]])),
+            jnp.asarray(np.concatenate([acc["values"][p],
+                                        wave.values[p]])),
+            jnp.asarray(np.concatenate([acc["payload"][p],
+                                        wave.payload[p]])),
+            jnp.asarray(np.concatenate([acc["valid"][p], wave.valid[p]])),
+            out_capacity, fin_op, unit_values=False)
+        assert int(fin.n_unique) <= out_capacity, "golden overflowed"
+        outs.append(fin)
+    return {f: np.stack([np.asarray(getattr(o, f)) for o in outs])
+            for f in ("keys", "values", "payload", "valid")}
+
+
+@pytest.mark.parametrize("opname,waves", [("sum", 3), ("min", 3),
+                                          ("max", 2), ("or", 3)])
+def test_fused_fold_matches_old_merge_golden(mesh, opname, waves):
+    n_dev = mesh.shape["data"]
+    k = 2
+    rng = np.random.default_rng(ord(opname[0]) + waves)
+    chunks = _chunks(rng, waves * n_dev * k)  # exact wave multiples
+    op = {"sum": "sum", "min": "min", "max": "max",
+          "or": jnp.bitwise_or}[opname]
+    cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                       out_capacity=256, reduce_op=op)
+    eng = DeviceEngine(mesh, _records_map_fn, cfg)
+
+    fused = eng.run(chunks, waves=waves, max_retries=0)
+    assert fused.overflow == 0
+
+    # golden: per-wave single-wave runs (same program, same per-device
+    # blocks) folded by the old merge semantics
+    rpw = n_dev * k
+    acc = None
+    for w in range(waves):
+        wave = eng.run(chunks[w * rpw:(w + 1) * rpw], waves=1,
+                       max_retries=0)
+        assert wave.overflow == 0
+        if acc is None:
+            acc = {"keys": wave.keys, "values": wave.values,
+                   "payload": wave.payload, "valid": wave.valid}
+        else:
+            acc = _old_merge_fold(acc, wave, cfg.out_capacity, op)
+
+    # bit-identical over the live prefix of every partition
+    for p in range(n_dev):
+        n_live = int(fused.valid[p].sum())
+        assert n_live == int(acc["valid"][p].sum()), f"partition {p}"
+        for field in ("keys", "values", "payload"):
+            a = np.asarray(getattr(fused, field)[p][:n_live])
+            b = acc[field][p][:n_live]
+            assert np.array_equal(a, b), (opname, p, field)
+
+    # and both match the host reference reduction
+    assert _result_dict(fused) == _dict_oracle(chunks, opname)
+
+
+# -- combiner on/off equivalence ---------------------------------------------
+
+def test_combiner_on_off_equivalence_engine(mesh):
+    rng = np.random.default_rng(7)
+    chunks = _chunks(rng, 4 * mesh.shape["data"], r=64)
+    results = []
+    for combine in (False, True):
+        # combine_capacity 56: above the worst-case per-chunk uniques
+        # for this seed (50 of the 115 key combos in a 64-record chunk)
+        # so the run is retry-free, below T=64 so the combiner genuinely
+        # compacts rather than degenerating to a dedup
+        cfg = EngineConfig(local_capacity=512, exchange_capacity=128,
+                           out_capacity=512, reduce_op="sum",
+                           combine_in_scan=combine, combine_capacity=56)
+        res = DeviceEngine(mesh, _records_map_fn, cfg).run(
+            chunks, waves=2, max_retries=0)
+        assert res.overflow == 0
+        results.append(_result_dict(res))
+    assert results[0] == results[1] == _dict_oracle(chunks, "sum")
+
+
+def test_combiner_on_off_equivalence_wordcount(mesh):
+    data = _random_text(n_words=6000, seed=11)
+    counts = []
+    for combine in (False, True):
+        wc = DeviceWordCount(
+            mesh, chunk_len=1024,
+            config=EngineConfig(local_capacity=1 << 12,
+                                exchange_capacity=1 << 10,
+                                out_capacity=1 << 12,
+                                combine_in_scan=combine))
+        counts.append(wc.count_bytes(data, waves=3))
+    assert counts[0] == counts[1] == _oracle(data)
+
+
+def test_combiner_overflow_retry_converges(mesh):
+    """Absurd combiner slots (4 per chunk) must overflow, be counted,
+    and be right-sized by the retry loop — never silently truncate."""
+    rng = np.random.default_rng(13)
+    chunks = _chunks(rng, 2 * mesh.shape["data"], r=64)
+    cfg = EngineConfig(local_capacity=16, exchange_capacity=8,
+                       out_capacity=16, reduce_op="sum",
+                       combine_in_scan=True, combine_capacity=4)
+    eng = DeviceEngine(mesh, _records_map_fn, cfg)
+    tm = {}
+    res = eng.run(chunks, timings=tm, waves=2)
+    assert tm["retries"] >= 1
+    assert res.overflow == 0
+    assert _result_dict(res) == _dict_oracle(chunks, "sum")
+
+
+# -- the execution model itself ----------------------------------------------
+
+def test_one_dispatch_per_wave_no_merge_program(mesh):
+    rng = np.random.default_rng(17)
+    chunks = _chunks(rng, 4 * mesh.shape["data"])
+    cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                       out_capacity=256, reduce_op="sum")
+    eng = DeviceEngine(mesh, _records_map_fn, cfg)
+    d0 = REGISTRY.value("mrtpu_device_dispatches_total", program="wave")
+    m0 = REGISTRY.value("mrtpu_device_dispatches_total", program="merge")
+    tm = {}
+    res = eng.run(chunks, timings=tm, waves=4)
+    assert tm["waves"] == 4 and tm["retries"] == 0
+    assert res.overflow == 0
+    disp = REGISTRY.value("mrtpu_device_dispatches_total",
+                          program="wave") - d0
+    assert disp == 4, f"{disp} dispatches for 4 waves"
+    assert REGISTRY.value("mrtpu_device_dispatches_total",
+                          program="merge") == m0 == 0
+
+
+def test_wave_inputs_and_accumulator_are_buffer_donors(mesh):
+    """The lowered wave program must declare the wave inputs (args 0-1)
+    and the accumulator (args 3-6) donated — buffer_donor / aliasing
+    tags in the MLIR — while n_real (arg 2, reused every wave) stays
+    undonated.  Lowering-level, so it holds on backends whose runtime
+    keeps unaliased donations alive."""
+    cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                       out_capacity=256, reduce_op="sum")
+    eng = DeviceEngine(mesh, _records_map_fn, cfg)
+    n_dev = mesh.shape["data"]
+    row_sh = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    shapes = (
+        jax.ShapeDtypeStruct((2 * n_dev, 32), np.int32, sharding=row_sh),
+        jax.ShapeDtypeStruct((2 * n_dev,), np.int32, sharding=row_sh),
+        jax.ShapeDtypeStruct((), np.int32, sharding=rep),
+    ) + tuple(
+        jax.ShapeDtypeStruct((n_dev,) + a.shape, a.dtype, sharding=row_sh)
+        for a in eng._fin_row_avals(cfg, (32,), np.int32))
+    txt = eng._get_compiled(cfg).lower(*shapes).as_text()
+    head = next(line for line in txt.splitlines()
+                if "func.func public @main" in line)
+    segs = head.split("%arg")[1:]
+    assert len(segs) == 7, head[:200]
+    donated = ["jax.buffer_donor = true" in s or "tf.aliasing_output" in s
+               for s in segs]
+    assert donated == [True, True, False, True, True, True, True], donated

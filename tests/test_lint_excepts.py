@@ -97,29 +97,62 @@ def _is_time_time_call(node: ast.AST) -> bool:
     return isinstance(f, ast.Name) and f.id == "time"
 
 
+def _wall_clock_offenders(paths, allowlist):
+    """``time.time()`` call sites across *paths* (absolute), minus the
+    *allowlist* (paths relative to the repo root) — the shared walker
+    for the package-tree and bench-script lints."""
+    offenders = []
+    root = os.path.dirname(PKG_ROOT)
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        if rel in allowlist:
+            continue
+        with open(path, "r") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if _is_time_time_call(node):
+                offenders.append(f"{rel}:{node.lineno}")
+    return offenders
+
+
+def _package_py_files():
+    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
 def test_no_wall_clock_time_outside_allowlist():
     """``time.time()`` is banned in the package: every use is either
     duration arithmetic (must be time.monotonic()) or a persisted
     timestamp (must go through coord/docstore.now so there is one mint
     point to reason about)."""
-    offenders = []
-    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, os.path.dirname(PKG_ROOT))
-            if rel in _WALL_CLOCK_ALLOWLIST:
-                continue
-            with open(path, "r") as f:
-                tree = ast.parse(f.read(), filename=path)
-            for node in ast.walk(tree):
-                if _is_time_time_call(node):
-                    offenders.append(f"{rel}:{node.lineno}")
+    offenders = _wall_clock_offenders(_package_py_files(),
+                                      _WALL_CLOCK_ALLOWLIST)
     assert not offenders, (
         "wall-clock time.time() outside the timestamp allowlist — use "
         "time.monotonic() for durations, docstore.now() for persisted "
         "timestamps: " + ", ".join(offenders))
+
+
+#: the repo-root bench harnesses: every number they print is a duration
+#: (wall_s, compute_s, steps/s), so the whole family is monotonic-only —
+#: an NTP step mid-bench must not corrupt a recorded BENCH*.json entry
+#: the regression gate will treat as truth.  No allowlist entries: a
+#: bench script needing a real timestamp mints it via docstore.now.
+_BENCH_SCRIPTS = ("bench.py", "bench_host.py", "bench_train.py")
+
+
+def test_no_wall_clock_time_in_bench_scripts():
+    root = os.path.dirname(PKG_ROOT)
+    paths = [os.path.join(root, s) for s in _BENCH_SCRIPTS]
+    missing = [p for p in paths if not os.path.exists(p)]
+    assert not missing, f"bench scripts moved? {missing}"
+    offenders = _wall_clock_offenders(paths, allowlist=frozenset())
+    assert not offenders, (
+        "wall-clock time.time() in a bench script — bench numbers are "
+        "durations and feed the regression-gate history; use "
+        "time.monotonic(): " + ", ".join(offenders))
 
 
 #: modules whose time readings become profiler spans or per-wave stage
