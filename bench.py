@@ -209,31 +209,74 @@ def check_smoke() -> int:
     # a genuinely multi-wave run (>= 2 waves) on a 1-device bench host
     # AND on the 8-device test mesh, so the fold path actually runs
     corpus = b"gate smoke alpha beta gamma delta " * 3000
+    # the engine's counters carry a per-task accounting label, so the
+    # smoke reads sum over it (superset label match)
     f0 = REGISTRY.sum("mrtpu_device_flops_total")
-    w0 = REGISTRY.value("mrtpu_device_waves_total")
-    d0 = REGISTRY.value("mrtpu_device_dispatches_total", program="wave")
+    w0 = REGISTRY.sum("mrtpu_device_waves_total")
+    d0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
     tm = {}
     counts = wc.count_bytes(corpus, timings=tm, waves=3)
     assert counts[b"alpha"] == 3000, counts.get(b"alpha")
-    waves_ran = REGISTRY.value("mrtpu_device_waves_total") - w0
+    waves_ran = REGISTRY.sum("mrtpu_device_waves_total") - w0
     assert waves_ran == tm["waves"] >= 2, (waves_ran, tm)
     # the fused execution model, asserted from the registry: EXACTLY one
     # program dispatch per wave (the fold rides inside it), zero merge
     # dispatches — and hence zero per-wave merge readbacks, since the
     # program that would have produced them no longer exists
     assert tm["retries"] == 0, tm  # retries would recount dispatches
-    dispatches = (REGISTRY.value("mrtpu_device_dispatches_total",
-                                 program="wave") - d0)
+    dispatches = (REGISTRY.sum("mrtpu_device_dispatches_total",
+                               program="wave") - d0)
     assert dispatches == waves_ran, (
         f"fused path dispatched {dispatches} programs for "
         f"{waves_ran} waves (expected exactly one per wave)")
-    merge_disp = REGISTRY.value("mrtpu_device_dispatches_total",
-                                program="merge")
+    merge_disp = REGISTRY.sum("mrtpu_device_dispatches_total",
+                              program="merge")
     assert merge_disp == 0, (
         f"{merge_disp} merge-program dispatches recorded — the "
         "two-dispatch wave fold came back")
     flops = REGISTRY.sum("mrtpu_device_flops_total") - f0
     assert flops > 0, "device run recorded no FLOPs (cost model broken)"
+
+    # collector overhead gate: telemetry for the whole engine run must
+    # fit a bounded number of push batches (the pusher batches the span
+    # ring, it does not chat per span/wave), lose NOTHING in a
+    # fault-free run, and yield a parseable merged timeline carrying
+    # the run's wave spans.
+    from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+    from mapreduce_tpu.obs.collector import TelemetryPusher
+    from mapreduce_tpu.obs.profile import validate_trace
+
+    p0 = REGISTRY.sum("mrtpu_telemetry_pushes_total")
+    dr0 = REGISTRY.sum("mrtpu_telemetry_dropped_total")
+    srv = DocServer().start_background()
+    pusher = TelemetryPusher(f"{srv.host}:{srv.port}",
+                             role="bench-smoke", interval=60.0)
+    try:
+        assert pusher.flush(), \
+            "telemetry push failed against a healthy collector"
+        # delta, not absolute: the suite may have run chaos pushers in
+        # this process before the smoke
+        drops = REGISTRY.sum("mrtpu_telemetry_dropped_total") - dr0
+        assert drops == 0, (
+            f"{drops} spans dropped in a fault-free smoke run")
+        pushes = REGISTRY.sum("mrtpu_telemetry_pushes_total") - p0
+        assert pushes <= max(2, waves_ran), (
+            f"collector overhead unbounded: {pushes} push batches for "
+            f"{waves_ran} waves (expected one batch for the whole run)")
+        client = HttpDocStore(f"{srv.host}:{srv.port}")
+        try:
+            cluster = client.clusterz()
+        finally:
+            client.close()
+        validate_trace(cluster)
+        wave_spans = sum(1 for e in cluster["traceEvents"]
+                         if e.get("name") == "wave")
+        assert wave_spans >= waves_ran, (
+            f"merged timeline carries {wave_spans} wave spans for "
+            f"{waves_ran} waves")
+    finally:
+        pusher.stop(flush=False)
+        srv.shutdown()
 
     print(json.dumps({
         "mode": "check_smoke", "ok": True,
@@ -242,6 +285,9 @@ def check_smoke() -> int:
         "dispatches_per_wave": dispatches / waves_ran,
         "device_flops_recorded": flops,
         "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
+        "telemetry_push_batches": pushes,
+        "telemetry_dropped": drops,
+        "cluster_timeline_wave_spans": wave_spans,
     }, default=float))
     return 0
 

@@ -14,9 +14,15 @@ strips ``.lua``):
   python -m mapreduce_tpu.cli status CONNSTR [--watch S] — live cluster
       view polled from the docserver's /statusz endpoint.
   python -m mapreduce_tpu.cli profile CONNSTR --out DIR — capture a
-      self-contained profile bundle (Chrome trace + /metrics + /statusz)
-      from a live docserver; bench.py --profile DIR does the same for a
-      single bench run.
+      self-contained profile bundle (Chrome trace + /metrics + /statusz
+      + merged cluster timeline + diagnosis) from a live docserver;
+      bench.py --profile DIR does the same for a single bench run.
+  python -m mapreduce_tpu.cli timeline CONNSTR --out FILE — fetch the
+      docserver's /clusterz MERGED cluster timeline (every process's
+      spans, clock-aligned) as one Perfetto-loadable file.
+  python -m mapreduce_tpu.cli diagnose CONNSTR — straggler / partition-
+      skew / fault-hotspot / phase-breakdown report over the merged
+      timeline (obs/analysis).
 
 CONNSTR is ``mem://NAME`` (single process), ``dir:///PATH`` (shared
 directory: OS processes on one host / NFS), or ``http://HOST:PORT``
@@ -29,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 from typing import List, Optional
 
@@ -110,21 +117,33 @@ def _add_trace(p: argparse.ArgumentParser) -> None:
                         "it — ~1KB of export per span)")
 
 
-def _setup_trace(args) -> None:
+def _setup_trace(args):
     """Apply trace flags BEFORE any span records (the ring bound must
-    hold from the first span, not from export time)."""
+    hold from the first span, not from export time).  With --trace-out
+    set, also arm the flight recorder: SIGTERM/atexit dump the ring +
+    registry to <trace-out>.flight.* paths, so a killed process no
+    longer loses its telemetry.  Returns the recorder (or None)."""
     if getattr(args, "trace_max_events", None):
         from .obs.trace import TRACER
 
         TRACER.max_events = max(1, args.trace_max_events)
+    if getattr(args, "trace_out", None):
+        from .obs.flight import install_flight_recorder
+
+        return install_flight_recorder(args.trace_out)
+    return None
 
 
-def _export_trace(args) -> None:
+def _export_trace(args, recorder=None) -> None:
     if getattr(args, "trace_out", None):
         from .obs.trace import TRACER
 
         print(f"trace written to {TRACER.export(args.trace_out)}",
               file=sys.stderr)
+        if recorder is not None:
+            # the normal export ran: flight files would be redundant
+            # (their presence is the abnormal-exit signal)
+            recorder.disarm()
 
 
 def _setup_logging(verbose: int) -> None:
@@ -149,13 +168,18 @@ def cmd_server(argv: List[str]) -> int:
     p.add_argument("--init-args", default=None,
                    help="JSON passed to every module init()")
     p.add_argument("--result-ns", default=None)
+    p.add_argument("--telemetry-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="seconds between telemetry pushes to the "
+                        "docserver's collector (default 1.0; <= 0 "
+                        "disables; http:// boards only)")
     _add_auth(p)
     _add_retry(p)
     _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
-    _setup_trace(args)
+    rec = _setup_trace(args)
 
     from .server import Server
 
@@ -177,10 +201,11 @@ def cmd_server(argv: List[str]) -> int:
         params["result_ns"] = args.result_ns
     server = Server(args.connstr, args.dbname, auth=args.auth,
                     retry=_retry_policy(args))
+    server.telemetry_interval = args.telemetry_interval
     server.configure(params)
     stats = server.loop()
     print(json.dumps(stats, default=float))
-    _export_trace(args)
+    _export_trace(args, rec)
     return 0
 
 
@@ -199,35 +224,49 @@ def cmd_worker(argv: List[str]) -> int:
     p.add_argument("--no-claim-ahead", action="store_true",
                    help="do not overlap the next batch's claim RPC with "
                         "the current job's execution")
+    p.add_argument("--name", default=None,
+                   help="worker name (metric/trace label; with "
+                        "--workers N > 1 each thread gets NAME-i). "
+                        "Default: an auto-generated host-unique name")
+    p.add_argument("--telemetry-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="seconds between telemetry pushes (spans + "
+                        "metric snapshot) to the docserver's collector "
+                        "over a dedicated socket (default 1.0; <= 0 "
+                        "disables; http:// boards only)")
     _add_auth(p)
     _add_retry(p)
     _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
-    _setup_trace(args)
+    rec = _setup_trace(args)
 
     from .worker import Worker, spawn_worker_threads
 
     conf = {k: v for k, v in (("max_iter", args.max_iter),
                               ("max_sleep", args.max_sleep),
                               ("max_tasks", args.max_tasks),
-                              ("claim_batch", args.claim_batch))
+                              ("claim_batch", args.claim_batch),
+                              ("telemetry_interval",
+                               args.telemetry_interval))
             if v is not None}
     if args.no_claim_ahead:
         conf["claim_ahead"] = False
     retry = _retry_policy(args)
     if args.workers == 1:
-        w = Worker(args.connstr, args.dbname, auth=args.auth, retry=retry)
+        w = Worker(args.connstr, args.dbname, auth=args.auth,
+                   name=args.name, retry=retry)
         w.configure(conf)
         w.execute()
     else:
         threads = spawn_worker_threads(args.connstr, args.dbname,
                                        args.workers, conf=conf,
-                                       auth=args.auth, retry=retry)
+                                       auth=args.auth, retry=retry,
+                                       name_prefix=args.name)
         for t in threads:
             t.join()
-    _export_trace(args)
+    _export_trace(args, rec)
     return 0
 
 
@@ -243,7 +282,7 @@ def cmd_wordcount(argv: List[str]) -> int:
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose)
-    _setup_trace(args)
+    rec = _setup_trace(args)
 
     import uuid
 
@@ -296,7 +335,7 @@ def cmd_wordcount(argv: List[str]) -> int:
             REGISTRY.sum("mrtpu_storage_bytes_total", direction="read"),
             REGISTRY.sum("mrtpu_http_retries_total")),
         file=sys.stderr)
-    _export_trace(args)
+    _export_trace(args, rec)
     if wedged:
         # a silent abandon here hides wedged shutdowns (a worker stuck in
         # a claim/IO call past the FINISHED broadcast); name the stragglers
@@ -361,7 +400,8 @@ def cmd_docserver(argv: List[str]) -> int:
     srv = DocServer(store, args.host, args.port, auth_token=args.auth)
     print(f"job board at http://{srv.host}:{srv.port} "
           f"(CONNSTR: \"http://HOST:{srv.port}\"; Prometheus at "
-          f"/metrics, cluster snapshot at /statusz)", flush=True)
+          f"/metrics, cluster snapshot at /statusz, merged cluster "
+          f"timeline at /clusterz)", flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -427,10 +467,48 @@ def _render_device(dev: dict) -> List[str]:
     return lines
 
 
+def _render_build(build: dict) -> List[str]:
+    if not build:
+        return []
+    return ["build: mrtpu {} | python {} | jax {} | backend {} ({})".format(
+        build.get("version", "?"), build.get("python", "?"),
+        build.get("jax", "?"), build.get("backend", "?"),
+        build.get("device_kind", "?"))]
+
+
+def _render_telemetry(tele: dict) -> List[str]:
+    """The collector section of /statusz: per-task roll-ups plus push
+    health per process."""
+    if not tele:
+        return []
+    lines: List[str] = []
+    tasks = tele.get("tasks") or {}
+    for t, r in sorted(tasks.items()):
+        lines.append(
+            "  task {}: {:.0f} records, {:.0f} B, {:.3f} device s, "
+            "{:.3g} FLOP".format(t, r.get("records", 0),
+                                 r.get("bytes", 0),
+                                 r.get("device_seconds", 0.0),
+                                 r.get("flops", 0)))
+    procs = tele.get("procs") or {}
+    for proc, p in sorted(procs.items()):
+        missed = p.get("missed") or 0
+        lines.append(
+            "  proc {} ({}): {} push(es), last {:.1f}s ago{}".format(
+                proc, p.get("role", "?"), p.get("pushes", 0),
+                p.get("last_push_age_s") or 0.0,
+                f", {missed} spans LOST" if missed else ""))
+    if lines:
+        lines.insert(0, "telemetry (cluster roll-ups via collector):")
+    return lines
+
+
 def render_status(snap: dict) -> str:
     """One-screen text view of a /statusz snapshot (the master status
     page role, Dean & Ghemawat §4.6)."""
-    lines: List[str] = _render_device(snap.get("device") or {})
+    lines: List[str] = _render_build(snap.get("build") or {})
+    lines += _render_device(snap.get("device") or {})
+    lines += _render_telemetry(snap.get("telemetry") or {})
     tasks = snap.get("tasks", {})
     if not tasks:
         lines.append("no tasks on this board")
@@ -616,6 +694,18 @@ def cmd_profile(argv: List[str]) -> int:
             print("note: server has no /tracez endpoint; bundling an "
                   "empty trace", file=sys.stderr)
             trace_doc = {"traceEvents": [], "displayTimeUnit": "ms"}
+        try:
+            cluster_doc = store.clusterz()
+        except PermissionError:
+            raise
+        except IOError as exc:
+            # same degradation contract as /tracez: only a pre-/clusterz
+            # server (404) yields a bundle without the cluster timeline
+            if "HTTP 404" not in str(exc):
+                raise
+            print("note: server has no /clusterz endpoint; bundling "
+                  "without a cluster timeline", file=sys.stderr)
+            cluster_doc = None
     except PermissionError as exc:
         print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
               file=sys.stderr)
@@ -627,10 +717,119 @@ def cmd_profile(argv: List[str]) -> int:
         store.close()
     out = obs_profile.write_bundle(
         args.out, metrics_text=metrics_text, statusz_doc=statusz_doc,
-        trace_doc=trace_doc)
+        trace_doc=trace_doc, cluster_doc=cluster_doc)
     n_ev = len(trace_doc.get("traceEvents", []))
     print(f"profile bundle written to {out} ({n_ev} trace events); "
           f"open trace.json in https://ui.perfetto.dev")
+    return 0
+
+
+def _docserver_client(connstr: str, auth, what: str):
+    """Shared HOST:PORT normalisation + HttpDocStore construction for
+    the exposition-plane commands (accepts pasted browser URLs)."""
+    from .coord.docserver import HttpDocStore
+
+    addr = connstr
+    if addr.startswith("http://"):
+        addr = addr[len("http://"):]
+    addr = addr.split("/", 1)[0]
+    try:
+        return HttpDocStore(addr, auth_token=auth)
+    except ValueError:
+        print(f"{what} wants a docserver address (http://HOST:PORT), "
+              f"got {connstr!r} — mem:// and dir:// boards live inside "
+              "their owning process and have no wire to poll",
+              file=sys.stderr)
+        return None
+
+
+def cmd_timeline(argv: List[str]) -> int:
+    """Fetch the docserver's /clusterz MERGED cluster timeline — every
+    pushed process's spans clock-aligned with the server's own, one
+    Perfetto-loadable file — and write it to --out."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu timeline")
+    p.add_argument("connstr",
+                   help="the docserver, http://HOST:PORT "
+                        "(the same CONNSTR workers use)")
+    p.add_argument("--out", required=True, metavar="FILE",
+                   help="where to write the merged Chrome trace JSON")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    store = _docserver_client(args.connstr, args.auth, "timeline")
+    if store is None:
+        return 2
+    try:
+        doc = store.clusterz()
+    except PermissionError as exc:
+        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"cannot reach {args.connstr}: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        store.close()
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, default=float)
+    cluster = doc.get("mrtpuCluster") or {}
+    print(f"cluster timeline written to {args.out} "
+          f"({len(doc.get('traceEvents') or [])} events from "
+          f"{len(cluster.get('procs') or {})} process(es)); open in "
+          "https://ui.perfetto.dev")
+    return 0
+
+
+def cmd_diagnose(argv: List[str]) -> int:
+    """Cluster diagnosis over the merged timeline: stragglers (robust
+    outlier test on claim->write latency), skewed partitions (share vs
+    uniform), retry/fault hotspots, and the claim/run/write phase
+    breakdown (obs/analysis)."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu diagnose")
+    p.add_argument("connstr",
+                   help="the docserver, http://HOST:PORT — or a saved "
+                        "timeline/cluster_trace.json file (offline "
+                        "diagnosis)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the structured report as JSON")
+    p.add_argument("--skew-ratio", type=float, default=None,
+                   metavar="R",
+                   help="flag partitions whose share exceeds R x the "
+                        "uniform share (default 2.0)")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    from .obs import analysis
+
+    if os.path.exists(args.connstr):
+        with open(args.connstr, encoding="utf-8") as f:
+            doc = json.load(f)
+    else:
+        store = _docserver_client(args.connstr, args.auth, "diagnose")
+        if store is None:
+            return 2
+        try:
+            doc = store.clusterz()
+        except PermissionError as exc:
+            print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+                  file=sys.stderr)
+            return 2
+        except OSError as exc:
+            print(f"cannot reach {args.connstr}: {exc}", file=sys.stderr)
+            return 1
+        finally:
+            store.close()
+    kw = ({"skew_ratio": args.skew_ratio}
+          if args.skew_ratio is not None else {})
+    report = analysis.diagnose(doc, **kw)
+    if args.as_json:
+        print(json.dumps(report, indent=2, default=float))
+    else:
+        sys.stdout.write(analysis.render_diagnosis(report))
     return 0
 
 
@@ -671,7 +870,8 @@ COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "wordcount": cmd_wordcount, "drop": cmd_drop,
             "blobserver": cmd_blobserver, "docserver": cmd_docserver,
             "warmup": cmd_warmup, "status": cmd_status,
-            "profile": cmd_profile}
+            "profile": cmd_profile, "timeline": cmd_timeline,
+            "diagnose": cmd_diagnose}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

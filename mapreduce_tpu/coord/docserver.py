@@ -44,6 +44,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs import metrics as _metrics
+from ..obs.collector import Collector
 from ..obs.statusz import cluster_status, update_board_gauges
 from ..obs.trace import TRACE_HEADER, TRACER
 from ..utils.httpclient import (
@@ -104,6 +105,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     evicted: "collections.OrderedDict[str, int]"  # session -> max evicted seq
     dedupe_lock: threading.Lock
     auth_token: Optional[str]  # None = open server
+    collector: Collector       # cluster telemetry sink (obs/collector)
 
     def log_message(self, *a):  # quiet
         pass
@@ -117,6 +119,8 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self) -> None:
+        if self.path == "/telemetry":
+            return self._do_telemetry()
         if self.path != "/rpc":
             return self._respond(404, b"{}")
         length = int(self.headers.get("Content-Length", 0))
@@ -228,18 +232,45 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                     ev.set()
         self._respond(200, body)
 
+    def _do_telemetry(self) -> None:
+        """The collector's push sink: workers/servers POST span batches +
+        metric snapshots here (obs/collector.TelemetryPusher).  Auth-
+        gated like /rpc; ingestion failures answer 4xx/5xx and never
+        kill the handler thread — a worker whose push bounces just
+        counts the loss and keeps working."""
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not check_auth(self.auth_token, self.headers):
+            return self._respond(401, b"{}")
+        try:
+            payload = json.loads(body)
+            if not isinstance(payload, dict):
+                raise ValueError("telemetry payload is not an object")
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
+            return self._respond(400, b"{}")
+        try:
+            ack = self.collector.push(payload, nbytes=len(body))
+        except Exception as exc:
+            return self._respond(500, json.dumps(
+                {"error": f"{type(exc).__name__}: {exc}"}).encode())
+        self._respond(200, json.dumps({"ok": True, **ack}).encode())
+
     def do_GET(self) -> None:
         """Exposition plane: ``/metrics`` (Prometheus text over the
         process-global registry, with job-board depth gauges refreshed at
-        scrape time), ``/statusz`` (JSON cluster snapshot), ``/tracez``
-        (this process's span ring as Chrome trace JSON — the ``profile``
-        CLI's bundle feed), ``/healthz``.  /metrics, /statusz and
-        /tracez are auth-gated like the RPC plane (the board's contents
-        leak through all three); /healthz is open — it returns a static
-        liveness body and nothing else, and orchestrator probes (k8s
-        httpGet, load balancers) cannot send a bearer token."""
+        scrape time), ``/statusz`` (JSON cluster snapshot, including the
+        collector's per-task roll-ups), ``/tracez`` (this process's span
+        ring as Chrome trace JSON — the ``profile`` CLI's bundle feed),
+        ``/clusterz`` (the MERGED cluster timeline: every pushed
+        process's spans clock-aligned with this process's, one
+        Perfetto-loadable file — the ``timeline``/``diagnose`` CLI
+        feed), ``/healthz``.  Everything but /healthz is auth-gated like
+        the RPC plane (the board's contents leak through all of them);
+        /healthz is open — it returns a static liveness body and nothing
+        else, and orchestrator probes (k8s httpGet, load balancers)
+        cannot send a bearer token."""
         if self.path not in ("/metrics", "/statusz", "/tracez",
-                             "/healthz"):
+                             "/clusterz", "/healthz"):
             return self._respond(404, b"{}")
         if self.path == "/healthz":
             _SCRAPES.inc(path=self.path)
@@ -255,8 +286,13 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             elif self.path == "/tracez":
                 body = json.dumps(TRACER.chrome_trace()).encode()
                 ctype = "application/json"
+            elif self.path == "/clusterz":
+                body = json.dumps(self.collector.cluster_doc(),
+                                  default=float).encode()
+                ctype = "application/json"
             else:
-                body = json.dumps(cluster_status(self.store)).encode()
+                body = json.dumps(cluster_status(
+                    self.store, collector=self.collector)).encode()
                 ctype = "application/json"
         except Exception as exc:
             # a scrape must never kill the handler thread mid-chaos; the
@@ -319,8 +355,10 @@ class DocServer:
             "evicted": collections.OrderedDict(),
             "dedupe_lock": threading.Lock(),
             "auth_token": default_auth_token(auth_token),
+            "collector": Collector(local_role="server"),
         })
         self.store = handler.store
+        self.collector = handler.collector
         self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
@@ -489,6 +527,16 @@ class HttpDocStore(DocStore):
             raise PermissionError("tracez: auth rejected")
         if status != 200:
             raise IOError(f"tracez: HTTP {status}")
+        return json.loads(raw)
+
+    def clusterz(self) -> Dict[str, Any]:
+        """Fetch the server's /clusterz merged cluster timeline (the
+        ``timeline``/``diagnose`` CLI feed)."""
+        status, raw = self._client.request("GET", "/clusterz")
+        if status == 401:
+            raise PermissionError("clusterz: auth rejected")
+        if status != 200:
+            raise IOError(f"clusterz: HTTP {status}")
         return json.loads(raw)
 
     def close(self) -> None:

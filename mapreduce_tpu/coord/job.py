@@ -29,6 +29,7 @@ import urllib.parse
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import spec
+from ..obs import metrics as _metrics
 from ..obs.trace import TRACER
 from ..utils.constants import (
     STATUS, TASK_STATUS, MAX_MAP_RESULT, MAP_RESULT_TEMPLATE)
@@ -40,6 +41,25 @@ from . import docstore
 from .connection import Connection
 
 logger = logging.getLogger("mapreduce_tpu.coord.job")
+
+# -- per-task accounting: the collector's roll-up substrate (task = the
+#    task database name — low cardinality by construction) and the skew
+#    inputs obs/analysis reads.  ``partition`` is bounded by
+#    num_reducers; map-side increments measure SHUFFLE VOLUME INTO each
+#    partition, which is exactly what partition-skew diagnosis wants. ---
+_TASK_RECORDS = _metrics.counter(
+    "mrtpu_task_records_total",
+    "record lines written by jobs, per task (labels: task, phase)")
+_TASK_BYTES = _metrics.counter(
+    "mrtpu_task_bytes_total",
+    "record bytes written by jobs, per task (labels: task, phase)")
+_PARTITION_RECORDS = _metrics.counter(
+    "mrtpu_partition_records_total",
+    "records routed into each reduce partition at map write time plus "
+    "records reduced out of it (labels: task, phase, partition)")
+_PARTITION_BYTES = _metrics.counter(
+    "mrtpu_partition_bytes_total",
+    "record bytes per reduce partition (labels: task, phase, partition)")
 
 
 def sanitize_token(s: str) -> str:
@@ -288,6 +308,16 @@ class Job:
         with TRACER.span("write", phase="map", job=self.get_id(),
                          partitions=len(per_part)):
             ns = map_results_prefix(self.path)
+            db = self._cnn.dbname
+            for part, lines in per_part.items():
+                nb = sum(len(ln) for ln in lines)
+                part_lbl = f"P{part:05d}"
+                _PARTITION_RECORDS.inc(len(lines), task=db, phase="map",
+                                       partition=part_lbl)
+                _PARTITION_BYTES.inc(nb, task=db, phase="map",
+                                     partition=part_lbl)
+                _TASK_RECORDS.inc(len(lines), task=db, phase="map")
+                _TASK_BYTES.inc(nb, task=db, phase="map")
 
             def put_one(part: int, lines: List[str]) -> None:
                 self._check_fence()
@@ -327,6 +357,8 @@ class Job:
             for n in files
         ]
         b = self._storage.builder()
+        n_out = 0
+        out_bytes = 0
         with TRACER.span("run", phase="reduce", job=self.get_id(),
                          inputs=len(files)):
             for key, values in merge_iterator(sources):
@@ -338,9 +370,20 @@ class Job:
                 else:
                     out = reducefn(key, values)
                 check_serializable(out)
-                b.write_record_line(serialize_record(key, [out]))
+                line = serialize_record(key, [out])
+                n_out += 1
+                out_bytes += len(line)
+                b.write_record_line(line)
         with TRACER.span("write", phase="reduce", job=self.get_id()):
             b.build(result_name)
+        db = self._cnn.dbname
+        # the reduce job id IS the partition token (P<nnnnn>)
+        _PARTITION_RECORDS.inc(n_out, task=db, phase="reduce",
+                               partition=str(self.get_id()))
+        _PARTITION_BYTES.inc(out_bytes, task=db, phase="reduce",
+                             partition=str(self.get_id()))
+        _TASK_RECORDS.inc(n_out, task=db, phase="reduce")
+        _TASK_BYTES.inc(out_bytes, task=db, phase="reduce")
         # deletion of consumed inputs is deferred to execute(), post-WRITTEN
         self._consumed = files
 

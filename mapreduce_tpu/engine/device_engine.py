@@ -76,24 +76,38 @@ AXIS = "data"
 #    plane plus per-wave histograms on the µs-capable DEVICE_BUCKETS
 #    (LATENCY_BUCKETS' 1ms floor collapses sub-millisecond waves) -----------
 _WAVES = _obs.counter("mrtpu_device_waves_total",
-                      "device-engine waves executed")
+                      "device-engine waves executed (labels: task)")
 _DISPATCHES = _obs.counter(
     "mrtpu_device_dispatches_total",
     "compiled programs dispatched by the device engine (labels: "
-    "program; the fused engine issues exactly one program=wave dispatch "
-    "per wave — a nonzero program=merge count would mean the deleted "
-    "two-dispatch path came back)")
+    "program, task; the fused engine issues exactly one program=wave "
+    "dispatch per wave — a nonzero program=merge count would mean the "
+    "deleted two-dispatch path came back)")
 _RETRIES = _obs.counter("mrtpu_device_retries_total",
-                        "capacity-overflow recompile retries")
+                        "capacity-overflow recompile retries "
+                        "(labels: task)")
 _STAGE_SECONDS = _obs.counter(
     "mrtpu_device_seconds_total",
-    "device-engine wall seconds by stage (labels: stage)")
+    "device-engine wall seconds by stage (labels: stage, task)")
 _WAVE_SECONDS = _obs.histogram(
     "mrtpu_device_wave_seconds",
     "per-wave device-plane stage seconds on the DEVICE_BUCKETS preset "
     "(labels: stage=wave|upload|compute|readback; compute is the "
-    "dispatch+fold time — device execution is async until readback)",
+    "dispatch+fold time — device execution is async until readback).  "
+    "Deliberately task-agnostic: per-task accounting rides the "
+    "counters, not the histogram's bucket fan-out",
     buckets=_obs.DEVICE_BUCKETS)
+# per-partition skew inputs for obs/analysis: the live row count (and
+# approximate bytes) of each partition's uniques after the last run's
+# exchange+fold — a lopsided hash partition shows here directly
+_PARTITION_RECORDS = _obs.gauge(
+    "mrtpu_device_partition_records",
+    "live unique rows per partition after the last device run "
+    "(labels: task, partition)")
+_PARTITION_BYTES = _obs.gauge(
+    "mrtpu_device_partition_bytes",
+    "approximate bytes of live rows per partition after the last "
+    "device run (labels: task, partition)")
 
 
 @dataclass(frozen=True)
@@ -296,11 +310,17 @@ class DeviceEngine:
     """
 
     def __init__(self, mesh: Mesh, map_fn: Callable,
-                 config: EngineConfig = EngineConfig()) -> None:
+                 config: EngineConfig = EngineConfig(),
+                 task: str = "-") -> None:
         self.mesh = mesh
         self.map_fn = map_fn
         self.config = config
         self.n_dev = mesh.shape[AXIS]
+        #: low-cardinality accounting label on every metric this engine
+        #: emits (the owning task's database name; "-" outside the task
+        #: machinery) — the cluster collector rolls device seconds and
+        #: FLOPs up by it
+        self.task_label = task or "-"
         self._compiled = {}
 
     # -- the SPMD program --------------------------------------------------
@@ -959,7 +979,8 @@ class DeviceEngine:
                             # the running uniques threaded through as
                             # donated args (out[:4] reuse their buffers)
                             out = fn(ci, ii, n_real, *acc)
-                            _DISPATCHES.inc(1, program="wave")
+                            _DISPATCHES.inc(1, program="wave",
+                                            task=self.task_label)
                             wave_oflows.append(out[4])
                             need_arrays.append(out[5])
                             acc = list(out[:4])
@@ -1047,11 +1068,25 @@ class DeviceEngine:
         # live counters for the exposition plane regardless of whether
         # the caller asked for a timings dict: per-wave upload/compute/
         # readback seconds are the device-path hot-path metrics
-        _WAVES.inc(W)
-        _RETRIES.inc(retries)
-        _STAGE_SECONDS.inc(t_upload, stage="upload")
-        _STAGE_SECONDS.inc(t_compute, stage="compute")
-        _STAGE_SECONDS.inc(t_readback, stage="readback")
+        _WAVES.inc(W, task=self.task_label)
+        _RETRIES.inc(retries, task=self.task_label)
+        _STAGE_SECONDS.inc(t_upload, stage="upload", task=self.task_label)
+        _STAGE_SECONDS.inc(t_compute, stage="compute",
+                           task=self.task_label)
+        _STAGE_SECONDS.inc(t_readback, stage="readback",
+                           task=self.task_label)
+        # per-partition skew inputs: the exchange's live row count per
+        # partition (n_live) and its approximate byte mass
+        row_bytes = sum(
+            a.dtype.itemsize * int(np.prod(a.shape[2:], dtype=np.int64))
+            if a.ndim > 2 else a.dtype.itemsize
+            for a in (keys_h, vals_h, pay_h))
+        for p, n in enumerate(np.asarray(n_live).reshape(-1)):
+            _PARTITION_RECORDS.set(int(n), task=self.task_label,
+                                   partition=f"P{p:05d}")
+            _PARTITION_BYTES.set(int(n) * row_bytes,
+                                 task=self.task_label,
+                                 partition=f"P{p:05d}")
         # cost model: FLOPs/bytes of the final wave program (XLA
         # cost_analysis, analytic fallback on backends without one) ->
         # flop/byte counters + derived MFU / roofline gauges.  The MFU
@@ -1064,7 +1099,8 @@ class DeviceEngine:
             derived = _profile.record_run(
                 costs, waves=W, compute_s=t_attempt_compute,
                 n_dev=self.n_dev,
-                device=next(iter(self.mesh.devices.flat)))
+                device=next(iter(self.mesh.devices.flat)),
+                task=self.task_label)
         if timings is not None:
             timings.update(derived)
             timings["waves"] = W

@@ -10,6 +10,13 @@
 * :mod:`.profile` — device-plane cost model (FLOPs/bytes via XLA
   ``cost_analysis`` with an analytic fallback), MFU/roofline gauges,
   and self-contained profile bundles (trace + metrics + statusz);
+* :mod:`.collector` — the cluster telemetry plane: span/metric push
+  collector with monotonic clock alignment, the merged ``/clusterz``
+  timeline assembler, and per-task roll-ups;
+* :mod:`.analysis` — cluster diagnosis over the merged timeline
+  (stragglers, partition skew, fault hotspots, phase breakdown);
+* :mod:`.buildinfo` — the ``mrtpu_build_info`` identity gauge;
+* :mod:`.flight` — flight-recorder dump on abnormal exit;
 * :mod:`.benchgate` — the bench regression gate (``--check``).
 
 Pure stdlib, imported by the hot paths (httpclient, docserver, worker,
@@ -23,3 +30,8 @@ from .trace import TRACE_HEADER, TRACER, Tracer  # noqa: F401
 from .statusz import cluster_status, update_board_gauges  # noqa: F401
 from .profile import (  # noqa: F401
     device_snapshot, load_bundle, validate_trace, write_bundle)
+from .collector import (  # noqa: F401
+    PROC_ID, Collector, TelemetryPusher, acquire_pusher, release_pusher)
+from .analysis import diagnose, render_diagnosis  # noqa: F401
+from .buildinfo import build_info  # noqa: F401
+from .flight import FlightRecorder, install_flight_recorder  # noqa: F401

@@ -1,0 +1,396 @@
+"""Cluster diagnosis over the merged timeline: stragglers, skew, faults.
+
+Consumes the ``/clusterz`` document (merged aligned spans +
+cluster-aggregated metrics, obs/collector.cluster_doc) and emits a
+structured report — the MapReduce-operator questions Dean & Ghemawat's
+backup-task machinery was built on top of, answered from telemetry
+instead of folklore:
+
+* **stragglers** — per-worker claim→write latency (the backdated
+  ``job`` spans) put through a robust LEAVE-ONE-OUT outlier test: a
+  worker is flagged when its median job latency exceeds the median of
+  every OTHER worker's jobs by more than ``STRAGGLER_MAD_K`` scaled
+  MADs (1.4826·MAD ≈ σ for normal data) AND by an absolute floor (so
+  µs-scale jitter on an idle cluster never flags anyone) AND by a
+  minimum ratio.  Leave-one-out, not pooled: a straggler that ran half
+  the cluster's jobs drags a pooled median toward itself and hides —
+  against everyone else's jobs it cannot.  Falls back to the
+  cluster-aggregated ``mrtpu_worker_job_seconds`` histogram sums when a
+  run's job spans were lost to telemetry drops — degraded telemetry
+  degrades the diagnosis, it does not blank it.
+
+* **skewed partitions** — per-partition record/byte counts from BOTH
+  planes (host: ``mrtpu_partition_records_total`` incremented at map
+  write time, i.e. shuffle volume into each partition; device:
+  ``mrtpu_device_partition_records`` from the engine's exchange
+  readback), flagged when a partition's share exceeds ``skew_ratio``
+  times the uniform share over the observed partitions.
+
+* **retry/fault hotspots** — the nonzero fault-path counters
+  (HTTP retries/exhaustions, lease losses, broken jobs, docserver
+  errors, telemetry drops), largest first.
+
+* **phase breakdown** — wall seconds by span name: claim vs run
+  (compute) vs write (blob), plus the device plane's
+  wave/upload/compute/readback, total and per worker.
+
+Everything here is pure arithmetic over an already-captured document —
+no clocks are read (the module still lives on the monotonic-only lint
+allowlist so a future edit cannot quietly add a steppable clock to the
+one module whose job is judging timelines).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+#: a worker is a straggler when its median job latency exceeds the
+#: pooled median by K scaled MADs ...
+STRAGGLER_MAD_K = 3.0
+#: ... and by this ratio (a 5% slowdown is noise, not a straggler) ...
+STRAGGLER_MIN_RATIO = 1.5
+#: ... and by this many absolute seconds (an idle cluster's µs jitter
+#: must never flag anyone)
+STRAGGLER_MIN_GAP_S = 0.05
+
+#: a partition is skewed when its share of the task's records exceeds
+#: skew_ratio × the uniform share over observed partitions
+SKEW_RATIO = 2.0
+
+#: rows reported per section, largest offender first
+TOP_K = 5
+
+#: fault-path families (and the label subsets that make them faults)
+#: surfaced as hotspots when nonzero
+_HOTSPOT_FAMILIES: Tuple[Tuple[str, Optional[Dict[str, Any]]], ...] = (
+    ("mrtpu_http_retries_total", None),
+    ("mrtpu_http_retryable_status_total", None),
+    ("mrtpu_http_exhausted_total", None),
+    ("mrtpu_worker_lease_lost_total", None),
+    ("mrtpu_worker_jobs_total", {"outcome": "broken"}),
+    ("mrtpu_worker_jobs_total", {"outcome": "fenced"}),
+    ("mrtpu_worker_released_jobs_total", None),
+    ("mrtpu_docserver_requests_total", {"outcome": "error"}),
+    ("mrtpu_docserver_requests_total", {"outcome": "evicted"}),
+    ("mrtpu_device_retries_total", None),
+    ("mrtpu_telemetry_dropped_total", None),
+)
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def _mad(xs: List[float], med: float) -> float:
+    return _median([abs(x - med) for x in xs])
+
+
+def _metric_rows(doc: Dict[str, Any]) -> List[Tuple[str, Dict[str, str],
+                                                    float]]:
+    rows = []
+    for row in (doc.get("mrtpuCluster") or {}).get("metrics") or []:
+        try:
+            name, labels, value = row
+            rows.append((str(name), dict(labels), float(value)))
+        except (TypeError, ValueError):
+            continue
+    return rows
+
+
+def _events(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [e for e in doc.get("traceEvents") or []
+            if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+# -- stragglers --------------------------------------------------------------
+
+
+def _worker_latencies(doc: Dict[str, Any]) -> Tuple[Dict[str, List[float]],
+                                                    str]:
+    """Per-worker claim→write latencies in seconds, preferring the
+    merged ``job`` spans; falling back to the aggregated
+    job-seconds histogram when spans were lost."""
+    per: Dict[str, List[float]] = {}
+    for e in _events(doc):
+        if e.get("name") != "job":
+            continue
+        worker = (e.get("args") or {}).get("worker")
+        if not worker or worker == "server":
+            continue
+        try:
+            per.setdefault(str(worker), []).append(float(e["dur"]) / 1e6)
+        except (KeyError, TypeError, ValueError):
+            continue
+    if per:
+        return per, "spans"
+    sums: Dict[str, float] = {}
+    counts: Dict[str, float] = {}
+    for name, labels, value in _metric_rows(doc):
+        w = labels.get("worker")
+        if not w:
+            continue
+        if name == "mrtpu_worker_job_seconds_sum":
+            sums[w] = sums.get(w, 0.0) + value
+        elif name == "mrtpu_worker_job_seconds_count":
+            counts[w] = counts.get(w, 0.0) + value
+    for w, n in counts.items():
+        if n > 0:
+            # the histogram only survives as mean latency; report it as
+            # one synthetic sample per worker (the outlier test is on
+            # per-worker medians either way)
+            per[w] = [sums.get(w, 0.0) / n]
+    return per, "metrics"
+
+
+def _find_stragglers(doc: Dict[str, Any]) -> Tuple[List[Dict[str, Any]],
+                                                   Dict[str, Any], str]:
+    per, source = _worker_latencies(doc)
+    workers: Dict[str, Any] = {}
+    for w, xs in per.items():
+        workers[w] = {
+            "jobs": len(xs),
+            "median_s": round(_median(xs), 4),
+            "mean_s": round(sum(xs) / len(xs), 4),
+            "total_s": round(sum(xs), 4),
+            "max_s": round(max(xs), 4),
+        }
+    stragglers: List[Dict[str, Any]] = []
+    if len(workers) >= 2:
+        for w, stats in workers.items():
+            others = [x for v, xs in per.items() if v != w for x in xs]
+            if not others:
+                continue
+            med = _median(others)
+            mad = _mad(others, med)
+            threshold = med + max(STRAGGLER_MAD_K * 1.4826 * mad,
+                                  STRAGGLER_MIN_GAP_S)
+            m = stats["median_s"]
+            if m > threshold and m > STRAGGLER_MIN_RATIO * max(med, 1e-9):
+                stragglers.append({
+                    "worker": w, "median_s": m, "jobs": stats["jobs"],
+                    "baseline_median_s": round(med, 4),
+                    "ratio": round(m / max(med, 1e-9), 2),
+                })
+        stragglers.sort(key=lambda s: -s["median_s"])
+    return stragglers, workers, source
+
+
+# -- partition skew ----------------------------------------------------------
+
+
+def _find_skew(doc: Dict[str, Any], skew_ratio: float,
+               top_k: int) -> List[Dict[str, Any]]:
+    # plane -> task -> partition -> records
+    counts: Dict[Tuple[str, str], Dict[str, float]] = {}
+    nbytes: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name in ("mrtpu_partition_records_total",
+                    "mrtpu_device_partition_records"):
+            dst = counts
+        elif name in ("mrtpu_partition_bytes_total",
+                      "mrtpu_device_partition_bytes"):
+            dst = nbytes
+        else:
+            continue
+        plane = "device" if name.startswith("mrtpu_device") else "host"
+        task = labels.get("task") or "-"
+        part = labels.get("partition")
+        if part is None:
+            continue
+        d = dst.setdefault((plane, task), {})
+        d[part] = d.get(part, 0.0) + value
+    skewed: List[Dict[str, Any]] = []
+    for (plane, task), parts in counts.items():
+        total = sum(parts.values())
+        n = len(parts)
+        if n < 2 or total <= 0:
+            continue
+        uniform = 1.0 / n
+        for part, v in parts.items():
+            share = v / total
+            if share > skew_ratio * uniform:
+                skewed.append({
+                    "plane": plane, "task": task, "partition": part,
+                    "records": int(v),
+                    "bytes": int(nbytes.get((plane, task), {})
+                                 .get(part, 0)),
+                    "share": round(share, 4),
+                    "uniform_share": round(uniform, 4),
+                    "ratio_vs_uniform": round(share / uniform, 2),
+                    "partitions_observed": n,
+                })
+    skewed.sort(key=lambda s: -s["share"])
+    return skewed[:top_k]
+
+
+# -- hotspots ----------------------------------------------------------------
+
+
+def _find_hotspots(doc: Dict[str, Any], top_k: int) -> List[Dict[str, Any]]:
+    hits: List[Dict[str, Any]] = []
+    for name, labels, value in _metric_rows(doc):
+        if value <= 0:
+            continue
+        for family, want in _HOTSPOT_FAMILIES:
+            if name != family:
+                continue
+            if want is not None and any(labels.get(k) != v
+                                        for k, v in want.items()):
+                continue
+            hits.append({"metric": name, "labels": labels,
+                         "value": value})
+    hits.sort(key=lambda h: -h["value"])
+    return hits[:top_k]
+
+
+# -- phase breakdown ---------------------------------------------------------
+
+_HOST_PHASES = ("claim", "run", "write")
+_DEVICE_PHASES = ("wave", "upload", "compute", "readback")
+
+
+def _phase_breakdown(doc: Dict[str, Any]) -> Dict[str, Any]:
+    totals: Dict[str, float] = {}
+    per_worker: Dict[str, Dict[str, float]] = {}
+    for e in _events(doc):
+        name = e.get("name")
+        if name not in _HOST_PHASES and name not in _DEVICE_PHASES:
+            continue
+        try:
+            dur = float(e.get("dur", 0.0)) / 1e6
+        except (TypeError, ValueError):
+            continue
+        totals[name] = totals.get(name, 0.0) + dur
+        worker = (e.get("args") or {}).get("worker")
+        if worker and name in _HOST_PHASES:
+            w = per_worker.setdefault(str(worker), {})
+            w[name] = w.get(name, 0.0) + dur
+    out: Dict[str, Any] = {
+        f"{p}_s": round(totals.get(p, 0.0), 4)
+        for p in _HOST_PHASES}
+    dev = {f"{p}_s": round(totals.get(p, 0.0), 4)
+           for p in _DEVICE_PHASES if totals.get(p)}
+    if dev:
+        out["device"] = dev
+    if per_worker:
+        out["per_worker"] = {
+            w: {f"{p}_s": round(v, 4) for p, v in d.items()}
+            for w, d in sorted(per_worker.items())}
+    return out
+
+
+# -- the report --------------------------------------------------------------
+
+
+def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
+             top_k: int = TOP_K) -> Dict[str, Any]:
+    """Structured diagnosis of a ``/clusterz`` document (also accepts a
+    bundle's ``cluster_trace.json``).  Pure function — safe to run
+    offline on a captured file."""
+    cluster = doc.get("mrtpuCluster") or {}
+    stragglers, workers, latency_source = _find_stragglers(doc)
+    report: Dict[str, Any] = {
+        "aligned_to": cluster.get("aligned_to"),
+        "n_procs": len(cluster.get("procs") or {}) or None,
+        "procs": cluster.get("procs") or {},
+        "tasks": cluster.get("tasks") or {},
+        "workers": workers,
+        "latency_source": latency_source,
+        "stragglers": stragglers,
+        "skew": _find_skew(doc, skew_ratio, top_k),
+        "hotspots": _find_hotspots(doc, top_k),
+        "phases": _phase_breakdown(doc),
+        "trace_events": len(doc.get("traceEvents") or []),
+    }
+    notes: List[str] = []
+    if not workers:
+        notes.append("no worker job latencies found (no job spans and "
+                     "no job-seconds metrics in the document)")
+    if latency_source == "metrics" and workers:
+        notes.append("job spans were lost to telemetry drops; straggler "
+                     "test ran on per-worker mean job seconds instead")
+    dropped = sum(v for name, _l, v in _metric_rows(doc)
+                  if name == "mrtpu_telemetry_dropped_total")
+    if dropped:
+        notes.append(f"{int(dropped)} span events were lost to the "
+                     "telemetry plane; the timeline is incomplete "
+                     "(jobs themselves were unaffected by design)")
+    report["notes"] = notes
+    return report
+
+
+def render_diagnosis(report: Dict[str, Any]) -> str:
+    """One-screen text rendering of a :func:`diagnose` report."""
+    lines: List[str] = []
+    n_procs = report.get("n_procs")
+    lines.append("cluster diagnosis ({} process{}, {} trace events)".format(
+        n_procs if n_procs is not None else "?",
+        "" if n_procs == 1 else "es", report.get("trace_events", 0)))
+
+    stragglers = report.get("stragglers") or []
+    if stragglers:
+        lines.append("STRAGGLERS:")
+        for s in stragglers:
+            lines.append(
+                "  worker {worker}: median job {median_s:.3f}s over "
+                "{jobs} job(s) — {ratio}x everyone else's median "
+                "({baseline_median_s:.3f}s)".format(**s))
+    else:
+        lines.append("stragglers: none detected")
+
+    skew = report.get("skew") or []
+    if skew:
+        lines.append("SKEWED PARTITIONS:")
+        for s in skew:
+            lines.append(
+                "  [{plane}] task {task} partition {partition}: "
+                "{records} records = {share:.1%} of the task "
+                "({ratio_vs_uniform}x uniform over "
+                "{partitions_observed} partitions)".format(**s))
+    else:
+        lines.append("partition skew: none detected")
+
+    hot = report.get("hotspots") or []
+    if hot:
+        lines.append("fault/retry hotspots:")
+        for h in hot:
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(h["labels"].items()))
+            lines.append(f"  {h['metric']}{{{lbl}}} = {h['value']:g}")
+    else:
+        lines.append("fault/retry hotspots: none")
+
+    phases = report.get("phases") or {}
+    lines.append(
+        "phase breakdown: claim {:.3f}s | run {:.3f}s | write {:.3f}s".format(
+            phases.get("claim_s", 0.0), phases.get("run_s", 0.0),
+            phases.get("write_s", 0.0)))
+    dev = phases.get("device")
+    if dev:
+        lines.append(
+            "  device: upload {:.3f}s  compute {:.3f}s  readback "
+            "{:.3f}s".format(dev.get("upload_s", 0.0),
+                             dev.get("compute_s", 0.0),
+                             dev.get("readback_s", 0.0)))
+    workers = report.get("workers") or {}
+    for w, st in sorted(workers.items()):
+        lines.append(
+            "  worker {}: {} job(s), median {:.3f}s, total {:.3f}s".format(
+                w, st["jobs"], st["median_s"], st["total_s"]))
+
+    tasks = report.get("tasks") or {}
+    for t, r in sorted(tasks.items()):
+        lines.append(
+            "  task {}: {:.0f} records, {:.0f} B, {:.3f} device s, "
+            "{:.3g} FLOP".format(t, r.get("records", 0),
+                                 r.get("bytes", 0),
+                                 r.get("device_seconds", 0.0),
+                                 r.get("flops", 0)))
+    for note in report.get("notes") or []:
+        lines.append(f"note: {note}")
+    return "\n".join(lines) + "\n"

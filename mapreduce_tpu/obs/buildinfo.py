@@ -1,0 +1,79 @@
+"""Build/environment identity: the ``mrtpu_build_info`` gauge.
+
+A bench entry, profile bundle or /statusz snapshot without an
+environment stamp is unattributable — "which jax, which backend, which
+device kind produced this number?" should be a label read, not an
+archaeology project.  The standard Prometheus idiom: a gauge whose
+value is always 1 and whose LABELS carry the identity (version, python,
+jax, backend, device kind), rendered in ``/statusz`` and the ``status``
+CLI.
+
+JAX fields are filled ONLY from an already-imported jax
+(``sys.modules``): the worker/docserver processes deliberately never
+import jax (seconds of startup they don't need), and an identity gauge
+must not change that.  They report ``jax="unloaded"`` — which is itself
+accurate identity information for those processes — and any process
+that did load jax (server device phase, bench) reports the real
+version/backend/device kind.  The cache refreshes itself the first time
+it is read after jax appears.
+"""
+
+from __future__ import annotations
+
+import logging
+import platform
+import sys
+import threading
+from typing import Dict, Optional
+
+from .metrics import gauge
+
+logger = logging.getLogger("mapreduce_tpu.obs.buildinfo")
+
+_BUILD_INFO = gauge(
+    "mrtpu_build_info",
+    "build/environment identity; value is always 1, the labels are the "
+    "payload (version, python, jax, backend, device_kind)")
+
+_lock = threading.Lock()
+_cache: Optional[Dict[str, str]] = None
+
+
+def _jax_fields() -> Dict[str, str]:
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {"jax": "unloaded", "backend": "unloaded",
+                "device_kind": "unloaded"}
+    out = {"jax": str(getattr(jax, "__version__", "?"))}
+    try:
+        out["backend"] = str(jax.default_backend())
+        out["device_kind"] = str(jax.devices()[0].device_kind)
+    except Exception as exc:
+        # a half-initialised or deviceless backend is a reportable
+        # state, not a crash in an identity probe
+        logger.debug("jax backend probe failed: %s", exc)
+        out.setdefault("backend", "unavailable")
+        out.setdefault("device_kind", "unavailable")
+    return out
+
+
+def build_info(refresh: bool = False) -> Dict[str, str]:
+    """The identity dict (cached); also (re)publishes the gauge.  The
+    cache self-refreshes once jax becomes importable after a first
+    jax-less read."""
+    global _cache
+    with _lock:
+        stale = (_cache is None or refresh
+                 or (_cache.get("jax") == "unloaded"
+                     and "jax" in sys.modules))
+        if stale:
+            from .. import __version__
+
+            info = {"version": __version__,
+                    "python": platform.python_version()}
+            info.update(_jax_fields())
+            _cache = info
+            # replace, not set: a refresh swaps the whole label set so a
+            # pre-jax series cannot linger next to the post-jax one
+            _BUILD_INFO.replace([(dict(info), 1.0)])
+        return dict(_cache)

@@ -164,11 +164,12 @@ def analytic_costs(input_bytes: int, n_records: int,
 
 _FLOPS = counter(
     "mrtpu_device_flops_total",
-    "device-engine FLOPs executed (labels: source=measured|analytic)")
+    "device-engine FLOPs executed (labels: source=measured|analytic, "
+    "task)")
 _BYTES = counter(
     "mrtpu_device_bytes_total",
     "device-engine bytes accessed per XLA cost model or analytic "
-    "fallback (labels: source)")
+    "fallback (labels: source, task)")
 _MFU = gauge(
     "mrtpu_device_mfu",
     "model FLOP/s utilisation of the last device run (achieved / peak)")
@@ -191,16 +192,21 @@ _PEAK_BW = gauge(
 
 
 def record_run(costs: Dict[str, Any], waves: int, compute_s: float,
-               n_dev: int, device: Any = None) -> Dict[str, Any]:
+               n_dev: int, device: Any = None,
+               task: str = "-") -> Dict[str, Any]:
     """Publish one device run's cost accounting (counters + derived
     MFU/roofline gauges) and return the derived fields — the engine
     folds them into its ``timings`` dict so they also reach the
-    persisted stats doc and ``/statusz`` per-task stats."""
+    persisted stats doc and ``/statusz`` per-task stats.  *task* is the
+    low-cardinality accounting label (the task database name; "-" when
+    the engine runs outside the task machinery) the cluster collector
+    rolls FLOPs up by."""
     source = str(costs.get("source", "measured"))
+    task = task or "-"
     flops = float(costs.get("flops", 0.0)) * max(int(waves), 0)
     nbytes = float(costs.get("bytes", 0.0)) * max(int(waves), 0)
-    _FLOPS.inc(flops, source=source)
-    _BYTES.inc(nbytes, source=source)
+    _FLOPS.inc(flops, source=source, task=task)
+    _BYTES.inc(nbytes, source=source, task=task)
     peaks = device_peaks(device)
     peak_f = peaks["flops_per_s"] * max(int(n_dev), 1)
     peak_b = peaks["bytes_per_s"] * max(int(n_dev), 1)
@@ -235,11 +241,14 @@ def device_snapshot(registry: Registry = REGISTRY) -> Dict[str, Any]:
     server/bench process — see the README's per-process scope caveat).
     Zero everywhere simply means no device run happened here."""
     val = registry.value
+    # the engine's counters carry a per-task accounting label; the
+    # process-wide device section sums over it (superset match)
     return {
-        "waves": int(val("mrtpu_device_waves_total")),
-        "retries": int(val("mrtpu_device_retries_total")),
+        "waves": int(registry.sum("mrtpu_device_waves_total")),
+        "retries": int(registry.sum("mrtpu_device_retries_total")),
         "seconds": {
-            stage: round(val("mrtpu_device_seconds_total", stage=stage), 4)
+            stage: round(registry.sum("mrtpu_device_seconds_total",
+                                      stage=stage), 4)
             for stage in ("upload", "compute", "readback")},
         "flops_total": registry.sum("mrtpu_device_flops_total"),
         "bytes_total": registry.sum("mrtpu_device_bytes_total"),
@@ -274,6 +283,14 @@ def validate_trace(doc: Any) -> None:
     for i, e in enumerate(events):
         if not isinstance(e, dict):
             raise ValueError(f"trace event {i}: not an object")
+        if e.get("ph") == "M":
+            # metadata events (process_name tracks in the merged cluster
+            # timeline) carry no interval — only identity
+            missing = {"name", "pid"} - set(e)
+            if missing:
+                raise ValueError(
+                    f"trace event {i}: metadata missing {sorted(missing)}")
+            continue
         missing = {"name", "ph", "ts", "dur", "pid", "tid"} - set(e)
         if missing:
             raise ValueError(f"trace event {i}: missing {sorted(missing)}")
@@ -290,6 +307,7 @@ def write_bundle(out_dir: str, store: Any = None,
                  statusz_doc: Optional[Dict[str, Any]] = None,
                  trace_doc: Optional[Dict[str, Any]] = None,
                  jax_trace_dir: Optional[str] = None,
+                 cluster_doc: Optional[Dict[str, Any]] = None,
                  registry: Registry = REGISTRY,
                  tracer: Tracer = TRACER) -> str:
     """Capture a self-contained profile bundle into *out_dir*.
@@ -301,7 +319,10 @@ def write_bundle(out_dir: str, store: Any = None,
     The ``profile`` CLI instead passes the text/docs it fetched from a
     live docserver.  *jax_trace_dir* (a ``jax.profiler`` trace
     directory, typically ``<out_dir>/jax_trace``) is recorded in the
-    manifest when it exists.  Returns *out_dir*."""
+    manifest when it exists.  *cluster_doc* (a ``/clusterz`` merged
+    cluster timeline) additionally lands as ``cluster_trace.json`` with
+    its structured diagnosis (obs/analysis) as ``diagnosis.json``.
+    Returns *out_dir*."""
     from ..coord import docstore  # lazy: the wall-clock mint point
 
     os.makedirs(out_dir, exist_ok=True)
@@ -318,6 +339,8 @@ def write_bundle(out_dir: str, store: Any = None,
     if trace_doc is None:
         trace_doc = tracer.chrome_trace()
     validate_trace(trace_doc)
+    if cluster_doc is not None:
+        validate_trace(cluster_doc)
 
     with open(os.path.join(out_dir, "metrics.prom"), "w",
               encoding="utf-8") as f:
@@ -329,11 +352,23 @@ def write_bundle(out_dir: str, store: Any = None,
               encoding="utf-8") as f:
         json.dump(trace_doc, f)
 
+    files = ["metrics.prom", "statusz.json", "trace.json"]
+    if cluster_doc is not None:
+        from .analysis import diagnose
+
+        with open(os.path.join(out_dir, "cluster_trace.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(cluster_doc, f, default=float)
+        with open(os.path.join(out_dir, "diagnosis.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(diagnose(cluster_doc), f, indent=1, default=float)
+        files += ["cluster_trace.json", "diagnosis.json"]
+
     manifest: Dict[str, Any] = {
         "kind": "mrtpu-profile-bundle",
         "version": 1,
         "created_time": docstore.now(),
-        "files": ["metrics.prom", "statusz.json", "trace.json"],
+        "files": files,
         "trace_events": len(trace_doc.get("traceEvents", [])),
     }
     if jax_trace_dir and os.path.isdir(jax_trace_dir):
@@ -366,10 +401,21 @@ def load_bundle(path: str) -> Dict[str, Any]:
     with open(os.path.join(path, "trace.json"), encoding="utf-8") as f:
         trace_doc = json.load(f)
     validate_trace(trace_doc)
-    return {
+    out = {
         "manifest": manifest,
         "metrics_text": metrics_text,
         "metrics": parse_prometheus(metrics_text),
         "statusz": statusz_doc,
         "trace": trace_doc,
     }
+    cluster_path = os.path.join(path, "cluster_trace.json")
+    if os.path.exists(cluster_path):
+        with open(cluster_path, encoding="utf-8") as f:
+            cluster_doc = json.load(f)
+        validate_trace(cluster_doc)
+        out["cluster_trace"] = cluster_doc
+    diag_path = os.path.join(path, "diagnosis.json")
+    if os.path.exists(diag_path):
+        with open(diag_path, encoding="utf-8") as f:
+            out["diagnosis"] = json.load(f)
+    return out

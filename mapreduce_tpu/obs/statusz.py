@@ -80,16 +80,24 @@ def _workers(store, colls, now: float) -> Dict[str, Dict[str, Any]]:
     return workers
 
 
-def cluster_status(store, now: Optional[float] = None) -> Dict[str, Any]:
+def cluster_status(store, now: Optional[float] = None,
+                   collector=None) -> Dict[str, Any]:
     """The /statusz document: one entry per task database on the board,
     plus the serving process's device-plane section (engine FLOPs/MFU —
     nonzero only where the engine actually ran; per-task device numbers
-    travel in the persisted ``stats.device`` doc either way)."""
+    travel in the persisted ``stats.device`` doc either way), the build
+    identity, and — when the serving process hosts a telemetry
+    *collector* (obs/collector) — the cluster's per-task roll-ups and
+    per-process push health."""
+    from .buildinfo import build_info
     from .profile import device_snapshot  # late: profile pulls trace
 
     now = time.time() if now is None else now
     out: Dict[str, Any] = {"now": now, "tasks": {},
-                           "device": device_snapshot()}
+                           "device": device_snapshot(),
+                           "build": build_info()}
+    if collector is not None:
+        out["telemetry"] = collector.summary()
     for db, colls in sorted(_dbnames(store).items()):
         task_doc = None
         if "task" in colls:

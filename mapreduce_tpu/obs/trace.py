@@ -78,7 +78,14 @@ class Tracer:
     def __init__(self, max_events: int = 100_000) -> None:
         self.max_events = max_events
         self._lock = threading.Lock()
-        self._events: Deque[Dict[str, Any]] = collections.deque()
+        # ring entries are (seq, event): seq is a process-lifetime
+        # monotonic counter (never reset) so an incremental consumer —
+        # the telemetry pusher — can ask for "everything after N" and
+        # learn exactly how many events the ring evicted before it read
+        # them (its lossy-but-counted contract)
+        self._events: Deque[Tuple[int, Dict[str, Any]]] = collections.deque()
+        self._seq = 0
+        self._reset_seq = 0  # high-water mark of deliberate reset()s
         self._tls = threading.local()
 
     # -- span stack -------------------------------------------------------
@@ -194,7 +201,8 @@ class Tracer:
         _SPANS.inc(name=sp.name)
         dropped = 0
         with self._lock:
-            self._events.append(event)
+            self._seq += 1
+            self._events.append((self._seq, event))
             # ring semantics: evict the OLDEST events past the bound, so
             # an export always holds the newest activity
             while len(self._events) > self.max_events:
@@ -207,7 +215,22 @@ class Tracer:
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
-            return list(self._events)
+            return [e for _, e in self._events]
+
+    def events_since(self, seq: int) -> Tuple[int, List[Dict[str, Any]],
+                                              int]:
+        """Events recorded after sequence number *seq* (0 = everything
+        still in the ring), as ``(new_seq, events, missed)``: pass
+        ``new_seq`` back next call, ``missed`` is how many events were
+        recorded after *seq* but already EVICTED by the ring — the
+        telemetry pusher counts them as lost rather than pretending the
+        timeline is complete.  Events wiped by a deliberate
+        :meth:`reset` are not loss and are not counted."""
+        with self._lock:
+            fresh = [e for s, e in self._events if s > seq]
+            base = max(seq, self._reset_seq)
+            missed = max(0, (self._seq - base) - len(fresh))
+            return self._seq, fresh, missed
 
     def chrome_trace(self) -> Dict[str, Any]:
         """The Chrome trace-event JSON object format (Perfetto-loadable)."""
@@ -223,6 +246,9 @@ class Tracer:
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
+            # a deliberate wipe, not ring loss: incremental consumers
+            # must not count the cleared events as dropped
+            self._reset_seq = self._seq
 
 
 #: the process-global tracer (the registry's sibling); instruments write

@@ -103,6 +103,12 @@ class Server:
         self.configured = False
         self.finished = False
         self.poll_sleep = DEFAULT_SLEEP
+        #: telemetry push cadence to the board's collector (seconds) —
+        #: the driver's spans join the merged cluster timeline the same
+        #: way the workers' do.  Off by default in the library (no
+        #: surprise background traffic for embedders); the server CLI
+        #: turns it on at 1.0s.
+        self.telemetry_interval = 0.0
         # device fast path state (configure(device=True)): the mesh and
         # compiled engine live on the server instance — single-controller
         # SPMD — and never enter the task document
@@ -253,7 +259,10 @@ class Server:
         if self._device_engine is None:
             from .engine import DeviceEngine, EngineConfig
             cfg = ds.config() if ds.config else EngineConfig()
-            self._device_engine = DeviceEngine(mesh, ds.map_fn, cfg)
+            # the task database name is the engine's accounting label:
+            # its waves/seconds/FLOPs roll up per task in the collector
+            self._device_engine = DeviceEngine(mesh, ds.map_fn, cfg,
+                                               task=self.cnn.dbname)
         return self._device_engine
 
     def _run_device_phase(self) -> None:
@@ -480,10 +489,25 @@ class Server:
         prev_auth = push_ambient_auth(
             self.cnn.auth_token(),
             ambient_scope(self.cnn, self.params.get("storage")))
+        # push the driver's spans/metrics to the board's collector (when
+        # the board is a networked docserver); telemetry failures can
+        # never fail the run (obs/collector contract).  The pusher is
+        # process-shared (acquire/release) — a driver colocated with
+        # worker threads must not deliver the shared ring twice.
+        from .obs.collector import acquire_pusher, release_pusher
+
+        try:
+            address = self.cnn.board_hostport()
+        except Exception:
+            address = None
+        lease = acquire_pusher(address, self.cnn.auth_token(),
+                               role=f"server:{self.cnn.dbname}",
+                               interval=self.telemetry_interval)
         try:
             return self._loop_impl()
         finally:
             restore_ambient_auth(prev_auth)
+            release_pusher(lease)
 
     def _loop_impl(self) -> Dict[str, Any]:
         it = 0

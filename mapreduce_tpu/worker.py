@@ -44,37 +44,38 @@ logger = logging.getLogger("mapreduce_tpu.worker")
 
 _CLAIMS = _metrics.counter(
     "mrtpu_worker_claims_total",
-    "claim-poll outcomes (labels: worker, outcome=claimed|idle|"
+    "claim-poll outcomes (labels: worker, task, outcome=claimed|idle|"
     "unreachable)")
 _CLAIM_BATCH = _metrics.histogram(
     "mrtpu_worker_claim_batch_jobs",
-    "jobs claimed per successful claim RPC (labels: worker) — the claim "
-    "pipelining win is this histogram's mean being > 1",
+    "jobs claimed per successful claim RPC (labels: worker, task) — the "
+    "claim pipelining win is this histogram's mean being > 1",
     buckets=(1, 2, 4, 8, 16, 32))
 _CLAIMED_JOBS = _metrics.counter(
     "mrtpu_worker_claimed_jobs_total",
-    "jobs claimed, summed over batches (labels: worker)")
+    "jobs claimed, summed over batches (labels: worker, task)")
 _RELEASED_JOBS = _metrics.counter(
     "mrtpu_worker_released_jobs_total",
     "claim-ahead jobs handed back to WAITING unrun at worker exit "
-    "(labels: worker)")
+    "(labels: worker, task)")
 _HEARTBEATS = _metrics.counter(
     "mrtpu_worker_heartbeats_total",
-    "per-claim heartbeat outcomes (labels: worker, outcome=ok|error|"
-    "lost); one batched RPC may account several claims")
+    "per-claim heartbeat outcomes (labels: worker, task, outcome=ok|"
+    "error|lost); one batched RPC may account several claims")
 _LEASE_LOST = _metrics.counter(
     "mrtpu_worker_lease_lost_total",
-    "jobs fenced after a confirmed lease loss (labels: worker)")
+    "jobs fenced after a confirmed lease loss (labels: worker, task)")
 _JOBS = _metrics.counter(
     "mrtpu_worker_jobs_total",
-    "jobs this worker finished, by outcome (labels: worker, phase, "
-    "outcome=written|broken|fenced)")
+    "jobs this worker finished, by outcome (labels: worker, task, "
+    "phase, outcome=written|broken|fenced)")
 _JOB_SECONDS = _metrics.histogram(
     "mrtpu_worker_job_seconds",
-    "wall seconds from claim to job outcome (labels: worker, phase)")
+    "wall seconds from claim to job outcome (labels: worker, task, "
+    "phase)")
 _CONSEC_FAILURES = _metrics.gauge(
     "mrtpu_worker_consecutive_failures",
-    "current unbroken run of job failures (labels: worker); "
+    "current unbroken run of job failures (labels: worker, task); "
     "MAX_WORKER_RETRIES ends the worker")
 
 
@@ -137,6 +138,9 @@ class Worker:
         self.cnn = Connection(connstr, dbname, auth, retry=retry)
         self.task = Task(self.cnn)
         self.name = name or f"{Connection.hostname()}-{id(self):x}"
+        #: the per-task accounting label on every metric this worker
+        #: emits (the task database name — low cardinality)
+        self._task = dbname
         self.max_iter = DEFAULT_MAX_ITER
         self.max_sleep = DEFAULT_MAX_SLEEP
         self.max_tasks = DEFAULT_MAX_TASKS
@@ -146,6 +150,18 @@ class Worker:
         #: next batch's claim overlaps the current job's execution
         self.claim_batch = DEFAULT_CLAIM_BATCH
         self.claim_ahead = True
+        #: telemetry push knobs: spans + metric snapshots go to the
+        #: docserver's collector every ``telemetry_interval`` seconds
+        #: over a DEDICATED socket (obs/collector.TelemetryPusher —
+        #: lossy-but-counted, can never block a heartbeat or job).
+        #: ``telemetry_address`` defaults to the board itself for
+        #: http:// connstrs.  The LIBRARY default is off (embedders —
+        #: and tests that put a fault proxy in front of the board —
+        #: must not grow surprise background traffic); the worker CLI
+        #: turns it on at 1.0s.
+        self.telemetry_interval = 0.0
+        self.telemetry_address: Optional[str] = None
+        self.telemetry_backlog = 20_000
         self.jobs_done = 0
         #: fence of the most recently started job — observable so
         #: tests/operators can see a fencing in flight
@@ -159,9 +175,10 @@ class Worker:
 
     def configure(self, conf: Dict[str, Any]) -> None:
         """worker.lua:142-148: max_iter / max_sleep / max_tasks knobs,
-        plus the claim-pipelining pair."""
+        plus the claim-pipelining pair and the telemetry-push knobs."""
         for k in ("max_iter", "max_sleep", "max_tasks", "claim_batch",
-                  "claim_ahead"):
+                  "claim_ahead", "telemetry_interval",
+                  "telemetry_address", "telemetry_backlog"):
             if k in conf:
                 setattr(self, k, conf[k])
         # claim_batch=0 would make every poll an idle poll forever — a
@@ -200,11 +217,12 @@ class Worker:
                     # network failure: ownership is UNKNOWN (the lease may
                     # still be live server-side), so keep beating — fencing
                     # on a guess would abort healthy jobs during a blip
-                    _HEARTBEATS.inc(worker=self.name, outcome="error")
+                    _HEARTBEATS.inc(worker=self.name, task=self._task,
+                                    outcome="error")
                     logger.exception("heartbeat failed")
                     continue
                 for (job_tbl, fence), ok in zip(pairs, owned):
-                    _HEARTBEATS.inc(worker=self.name,
+                    _HEARTBEATS.inc(worker=self.name, task=self._task,
                                     outcome="ok" if ok else "lost")
                     if not ok and not stop.is_set():
                         # the server answered and this claim no longer
@@ -215,7 +233,7 @@ class Worker:
                         logger.warning(
                             "%s: lease lost on job %s — fencing",
                             self.name, job_tbl["_id"])
-                        _LEASE_LOST.inc(worker=self.name)
+                        _LEASE_LOST.inc(worker=self.name, task=self._task)
                         fence.set()
                         with self._held_lock:
                             self._held.pop(job_tbl["_id"], None)
@@ -273,10 +291,11 @@ class Worker:
                                      self.name)
             finally:
                 root.args["outcome"] = outcome
-                _JOBS.inc(worker=self.name, phase=status.value,
-                          outcome=outcome)
+                _JOBS.inc(worker=self.name, task=self._task,
+                          phase=status.value, outcome=outcome)
                 _JOB_SECONDS.observe(time.monotonic() - t_claim0,
-                                     worker=self.name, phase=status.value)
+                                     worker=self.name, task=self._task,
+                                     phase=status.value)
         return outcome
 
     def _release(self, coll: str,
@@ -296,7 +315,7 @@ class Worker:
                            len(leftovers), exc_info=True)
             return
         if n:
-            _RELEASED_JOBS.inc(n, worker=self.name)
+            _RELEASED_JOBS.inc(n, worker=self.name, task=self._task)
 
     def _jobs_coll(self, status: TASK_STATUS) -> str:
         return (self.task.map_jobs_ns() if status == TASK_STATUS.MAP
@@ -334,7 +353,8 @@ class Worker:
                     # reset): an idle poll, not a death sentence — back off
                     # like any idle iteration; a board that never comes
                     # back exhausts max_iter and the worker exits normally
-                    _CLAIMS.inc(worker=self.name, outcome="unreachable")
+                    _CLAIMS.inc(worker=self.name, task=self._task,
+                                outcome="unreachable")
                     logger.warning("%s: job board unreachable (%s); "
                                    "backing off", self.name, claim.error)
                     iter_count += 1
@@ -342,7 +362,8 @@ class Worker:
                     sleep = min(sleep * 1.5, self.max_sleep)
                     continue
                 if not claim.jobs:
-                    _CLAIMS.inc(worker=self.name, outcome="idle")
+                    _CLAIMS.inc(worker=self.name, task=self._task,
+                                outcome="idle")
                     if claim.status == TASK_STATUS.FINISHED:
                         return worked
                     # idle: exponential backoff (worker.lua:97-103)
@@ -353,9 +374,12 @@ class Worker:
 
                 status, task_tbl = claim.status, claim.task_tbl
                 coll = self._jobs_coll(status)
-                _CLAIMS.inc(worker=self.name, outcome="claimed")
-                _CLAIM_BATCH.observe(len(claim.jobs), worker=self.name)
-                _CLAIMED_JOBS.inc(len(claim.jobs), worker=self.name)
+                _CLAIMS.inc(worker=self.name, task=self._task,
+                            outcome="claimed")
+                _CLAIM_BATCH.observe(len(claim.jobs), worker=self.name,
+                                     task=self._task)
+                _CLAIMED_JOBS.inc(len(claim.jobs), worker=self.name,
+                                  task=self._task)
                 # fences were minted at registration time (inside the
                 # claim RPC's thread) — the batch has been heartbeated
                 # since the moment it was claimed
@@ -392,7 +416,8 @@ class Worker:
                             failures = 0
                         elif outcome == "broken":
                             failures += 1
-                        _CONSEC_FAILURES.set(failures, worker=self.name)
+                        _CONSEC_FAILURES.set(failures, worker=self.name,
+                                             task=self._task)
                         if failures >= MAX_WORKER_RETRIES:
                             logger.error(
                                 "%s: %d consecutive failures, giving up "
@@ -425,10 +450,41 @@ class Worker:
             stop_beat.set()
             beat_t.join()
 
+    def _start_telemetry(self):
+        """Lease the process-shared telemetry pusher when the board is a
+        networked docserver (the collector lives there) — shared, not
+        per-worker: N workers in one process drain ONE span ring, so one
+        pusher delivers it once.  Any failure means 'no telemetry',
+        never 'no worker'."""
+        from .obs.collector import acquire_pusher
+
+        address = self.telemetry_address
+        if not address:
+            try:
+                address = self.cnn.board_hostport()
+            except Exception:
+                address = None
+        return acquire_pusher(address, self.cnn.auth_token(),
+                              role=f"worker:{self.name}",
+                              interval=self.telemetry_interval,
+                              max_backlog=self.telemetry_backlog)
+
     def execute(self) -> None:
         """Top-level entry (worker.lua:112-138): serve up to max_tasks
         tasks, waiting for each to appear."""
+        from .obs.collector import release_pusher
+
         logger.info("worker %s starting", self.name)
+        lease = self._start_telemetry()
+        try:
+            self._execute_tasks()
+        finally:
+            # the LAST worker out stops the shared pusher with a final
+            # flush, so the process's closing spans reach the merged
+            # timeline; anything undeliverable is counted dropped
+            release_pusher(lease)
+
+    def _execute_tasks(self) -> None:
         for _ in range(self.max_tasks):
             # wait for a task document to exist and leave WAIT
             iter_count = 0
@@ -459,13 +515,17 @@ def spawn_worker_threads(connstr: str, dbname: str, n: int,
                          conf: Optional[Dict[str, Any]] = None,
                          auth: Optional[Any] = None,
                          retry: Optional[Any] = None,
+                         name_prefix: Optional[str] = None,
                          ) -> List[threading.Thread]:
     """Run *n* workers as daemon threads in this process — the rebuild's
     'fake cluster' for tests and the single-host deployment (the reference
-    uses N OS processes under ``screen``, test.sh:10)."""
+    uses N OS processes under ``screen``, test.sh:10).  *name_prefix*
+    overrides the default ``w<i>`` naming (``<prefix>-<i>``) so
+    multi-process deployments keep worker metric/trace labels distinct."""
     threads = []
     for i in range(n):
-        w = Worker(connstr, dbname, auth=auth, name=f"w{i}", retry=retry)
+        name = f"{name_prefix}-{i}" if name_prefix else f"w{i}"
+        w = Worker(connstr, dbname, auth=auth, name=name, retry=retry)
         if conf:
             w.configure(conf)
         t = threading.Thread(target=w.execute, daemon=True,

@@ -252,17 +252,17 @@ def test_one_dispatch_per_wave_no_merge_program(mesh):
     cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
                        out_capacity=256, reduce_op="sum")
     eng = DeviceEngine(mesh, _records_map_fn, cfg)
-    d0 = REGISTRY.value("mrtpu_device_dispatches_total", program="wave")
-    m0 = REGISTRY.value("mrtpu_device_dispatches_total", program="merge")
+    d0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    m0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="merge")
     tm = {}
     res = eng.run(chunks, timings=tm, waves=4)
     assert tm["waves"] == 4 and tm["retries"] == 0
     assert res.overflow == 0
-    disp = REGISTRY.value("mrtpu_device_dispatches_total",
-                          program="wave") - d0
+    disp = REGISTRY.sum("mrtpu_device_dispatches_total",
+                        program="wave") - d0
     assert disp == 4, f"{disp} dispatches for 4 waves"
-    assert REGISTRY.value("mrtpu_device_dispatches_total",
-                          program="merge") == m0 == 0
+    assert REGISTRY.sum("mrtpu_device_dispatches_total",
+                        program="merge") == m0 == 0
 
 
 def test_wave_inputs_and_accumulator_are_buffer_donors(mesh):

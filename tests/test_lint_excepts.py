@@ -164,6 +164,13 @@ _MONOTONIC_ONLY_MODULES = {
     os.path.join("mapreduce_tpu", "engine", "wordcount.py"),
     os.path.join("mapreduce_tpu", "obs", "trace.py"),
     os.path.join("mapreduce_tpu", "obs", "profile.py"),
+    # the cluster telemetry plane: the collector's clock-offset
+    # estimation and the pusher's send stamps ARE span timebase — a
+    # steppable clock anywhere here would silently skew the merged
+    # timeline; analysis.py reads no clocks at all, which this lint
+    # also pins down
+    os.path.join("mapreduce_tpu", "obs", "collector.py"),
+    os.path.join("mapreduce_tpu", "obs", "analysis.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions

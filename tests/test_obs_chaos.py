@@ -117,6 +117,77 @@ def test_metrics_scrape_stays_parseable_through_blob_5xx_storm(
         board.shutdown()
 
 
+def test_telemetry_loss_never_fails_jobs_and_is_counted(tmp_path):
+    """PR-6 loss-tolerance criterion: the workers' telemetry pushes are
+    routed through a fault proxy that 503s EVERY push, while the job
+    plane talks to the board directly.  Jobs must still complete
+    exactly-once, the lost spans must be counted in
+    mrtpu_telemetry_dropped_total, and the merged /clusterz timeline
+    must stay parseable (degraded to the processes that could push —
+    here, just the local one)."""
+    from mapreduce_tpu.obs.profile import validate_trace
+
+    corpus = []
+    for i in range(4):
+        p = tmp_path / f"t{i}.txt"
+        p.write_text(f"alpha beta t{i} gamma alpha\n" * 5)
+        corpus.append(str(p))
+    board = DocServer().start_background()
+    sched = FaultSchedule()
+    # windowed rule = unlimited count: EVERY push bounces for the whole
+    # run (a countable rule would expire after one hit)
+    storm = sched.http_error(status=503, for_secs=3600.0)
+    proxy = FaultProxy(board.host, board.port, schedule=sched).start()
+    connstr = f"http://{board.host}:{board.port}"
+    d0 = REGISTRY.sum("mrtpu_telemetry_dropped_total")
+    try:
+        chaos_mods.reset(corpus)
+        params = {r: M for r in ("taskfn", "mapfn", "partitionfn",
+                                 "reducefn", "finalfn")}
+        params["storage"] = f"mem:{uuid.uuid4().hex}"
+        # board traffic direct; telemetry through the 503 storm, with a
+        # tiny backlog so mid-run overflow drops are exercised too
+        threads = spawn_worker_threads(
+            connstr, "tlm", 2, retry=CHAOS_RETRY,
+            conf={"telemetry_address": proxy.address,
+                  "telemetry_interval": 0.05, "telemetry_backlog": 16})
+        server = Server(connstr, "tlm", retry=CHAOS_RETRY)
+        server.telemetry_interval = 0  # the workers are under test
+        server.configure(params)
+        stats = server.loop()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(not t.is_alive() for t in threads)
+    finally:
+        proxy.stop()
+
+    try:
+        assert storm.hits > 0, "no telemetry push ever hit the storm"
+        # jobs were untouched: exactly-once execution, correct result
+        assert chaos_mods.RESULT == naive.wordcount(corpus)
+        assert stats["map"]["failed"] == 0
+        for key, n in chaos_mods.STARTED.items():
+            assert n == 1 == chaos_mods.COMPLETED[key], (key, n)
+        # the loss is COUNTED, not silent: every undelivered span landed
+        # in the dropped counter (backlog overflow mid-run and/or the
+        # final shutdown flush)
+        dropped = REGISTRY.sum("mrtpu_telemetry_dropped_total") - d0
+        assert dropped > 0
+        assert REGISTRY.value("mrtpu_telemetry_pushes_total",
+                              outcome="error") > 0
+        # the merged timeline survives the loss: parseable, served, and
+        # carrying at least the local process's spans
+        s = HttpDocStore(f"{board.host}:{board.port}")
+        try:
+            doc = s.clusterz()
+        finally:
+            s.close()
+        validate_trace(doc)
+        assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+    finally:
+        board.shutdown()
+
+
 def test_breaker_transitions_visible_in_scrape():
     """A dead endpoint trips the breaker open, the cooldown half-opens
     it, a healed endpoint closes it — and all three transitions are

@@ -154,14 +154,14 @@ def test_cost_model_analytic_fallback(monkeypatch):
 
     monkeypatch.setattr(de._profile, "program_costs",
                         lambda compiled: None)
-    a0 = REGISTRY.value("mrtpu_device_flops_total", source="analytic")
+    a0 = REGISTRY.sum("mrtpu_device_flops_total", source="analytic")
     wc = _tiny_wc()
     t = {}
     wc.count_bytes(b"fall back to analytic " * 200, timings=t)
     assert t["cost_source"] == "analytic"
     assert t["flops"] > 0
-    assert REGISTRY.value("mrtpu_device_flops_total",
-                          source="analytic") > a0
+    assert REGISTRY.sum("mrtpu_device_flops_total",
+                        source="analytic") > a0
 
 
 # -- wave-span nesting (acceptance) ------------------------------------------
