@@ -17,6 +17,14 @@ MFU = achieved training FLOP/s over the chip's peak bf16 FLOP/s (v5e:
 APRIL-ANN init.lua:12) — tiny by design, so its MFU is reported but
 meaningless; the transformer is the beyond-parity long-context family and
 is the real MXU utilisation story.
+
+Elastic-training gate: every run also measures ``trainer_recovery_s``
+(successor lease acquire -> restore of the latest sharded checkpoint ->
+first epoch committed; README "Preemption-tolerant training").
+``--check`` gates this run against BENCH_TRAIN.json's ``history``
+(obs/benchgate.py medians + tolerances) and appends on pass;
+``--check --smoke`` measures and gates ONLY the recovery key (CI-safe
+on a CPU box — the throughput specs are not ``required``).
 """
 
 from __future__ import annotations
@@ -33,6 +41,100 @@ PEAK_FLOPS = {"tpu": 197e12, "cpu": None}
 
 STEPS = 20
 WARMUP = 3
+
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_TRAIN.json")
+
+
+def train_specs():
+    """Per-metric tolerances for ``--check`` (obs/benchgate.py): the
+    throughput keys ride the tunnelled fixture's wide swings; the
+    recovery key is the elastic-training gate — step-recovery time
+    (successor lease acquire -> restore -> first epoch committed) must
+    not silently regress.  Throughput keys are not ``required`` so a
+    CPU smoke check (which measures only recovery) still gates."""
+    from mapreduce_tpu.obs.benchgate import MetricSpec
+
+    return [
+        MetricSpec("mlp_train_steps_per_s", rel_tol=0.50,
+                   direction="higher"),
+        MetricSpec("transformer_train_tokens_per_s", rel_tol=0.35,
+                   direction="higher"),
+        MetricSpec("trainer_recovery_s", rel_tol=1.50,
+                   direction="lower", required=True),
+    ]
+
+
+def bench_recovery(mesh):
+    """``trainer_recovery_s``: fenced-failover step-recovery time.
+
+    A predecessor trains 3 epochs with sharded checkpoints + a trainer
+    lease on a board, then releases (the clean-preemption form; the
+    expiry form is tests/test_train_failover.py's chaos scenario).  The
+    timed region is everything a successor pays before it is making
+    progress again: lease acquire -> restore of the latest complete
+    checkpoint (digest-verified, resharded onto its mesh) -> first
+    epoch applied AND committed.  Includes the successor's jit compile
+    — a real failover pays it too."""
+    import tempfile
+    import uuid
+
+    from mapreduce_tpu.coord import Connection, TrainerLease
+    from mapreduce_tpu.models import (
+        DistributedTrainer, MLPConfig, TrainConfig, make_digits)
+    from mapreduce_tpu.models.checkpoint import CheckpointManager
+    from mapreduce_tpu.storage.localdir import LocalDirStorage
+
+    root = tempfile.mkdtemp(prefix="mrtpu_recovery_")
+    board = f"mem://{uuid.uuid4().hex}"
+    x_tr, y_tr, x_va, y_va = make_digits()
+
+    def make_trainer(max_epochs):
+        return DistributedTrainer(
+            mesh, MLPConfig(sizes=(256, 64, 10)),
+            TrainConfig(bunch_size=32, max_epochs=max_epochs,
+                        min_epochs=1, patience=100))
+
+    mgr = CheckpointManager(LocalDirStorage(root), keep_n=2)
+    pre = TrainerLease(Connection(board, "train"), holder="pre",
+                       lease=30.0)
+    pre.acquire(timeout=10)
+    out = make_trainer(3).fit(x_tr, y_tr, x_va, y_va, manager=mgr,
+                              lease=pre)
+    assert out["epochs_run"] == 3, out
+    pre.release()
+
+    suc = TrainerLease(Connection(board, "train"), holder="suc",
+                       lease=30.0)
+    t0 = time.monotonic()
+    suc.acquire(timeout=10)
+    out = make_trainer(4).fit(x_tr, y_tr, x_va, y_va, manager=mgr,
+                              lease=suc)
+    sec = time.monotonic() - t0
+    assert out["restored"] and out["start_epoch"] == 4, out
+    suc.release()
+    return {"metric": "trainer_recovery_s", "value": round(sec, 3),
+            "unit": "s", "restored_step": 3,
+            "n_devices": len(mesh.devices.flat)}
+
+
+def run_check(rows, path=HISTORY_PATH, append=True):
+    """Gate this run's rows against the file's ``history`` and append
+    on pass; returns the regression list (empty = accepted)."""
+    import jax
+
+    from mapreduce_tpu.obs import benchgate
+
+    entry = {r["metric"]: r["value"] for r in rows}
+    plat = jax.devices()[0].platform
+    entry["platform"] = plat
+    # baseline on same-platform entries only (an entry without the
+    # platform stamp predates it and counts): a TPU recovery includes
+    # a multi-second jit compile a CPU run never pays — cross-platform
+    # medians would false-fail one direction and mask the other
+    return benchgate.check_and_append(
+        path, entry, train_specs(), key="history", append=append,
+        match=lambda h: h.get("platform", plat) == plat)
 
 
 def _timeit(step_fn, n=None):
@@ -228,31 +330,52 @@ def main() -> None:
     platform = jax.devices()[0].platform
     mesh = make_mesh()
     smoke = "--smoke" in sys.argv
+    check = "--check" in sys.argv
     if smoke:
         global STEPS
         STEPS = 3
 
     rows = []
-    print(f"# platform={platform} devices={len(mesh.devices.flat)}; "
-          "mlp ...", file=sys.stderr, flush=True)
-    rows.append(bench_mlp(mesh, platform))
-    print(json.dumps(rows[-1]), flush=True)
-    print("# transformer ...", file=sys.stderr, flush=True)
-    rows.append(bench_transformer(mesh, platform))
-    print(json.dumps(rows[-1]), flush=True)
-    if not smoke and platform == "tpu":
-        print("# 32k context ...", file=sys.stderr, flush=True)
-        rows.append(bench_longctx(mesh, platform))
+    if not (check and smoke):
+        # --check --smoke is the recovery-only gate (CI-safe: no
+        # transformer bench on a CPU box); everything else runs the
+        # full throughput families first
+        print(f"# platform={platform} devices={len(mesh.devices.flat)}; "
+              "mlp ...", file=sys.stderr, flush=True)
+        rows.append(bench_mlp(mesh, platform))
         print(json.dumps(rows[-1]), flush=True)
+        print("# transformer ...", file=sys.stderr, flush=True)
+        rows.append(bench_transformer(mesh, platform))
+        print(json.dumps(rows[-1]), flush=True)
+        if not smoke and platform == "tpu":
+            print("# 32k context ...", file=sys.stderr, flush=True)
+            rows.append(bench_longctx(mesh, platform))
+            print(json.dumps(rows[-1]), flush=True)
+
+    print("# recovery ...", file=sys.stderr, flush=True)
+    rows.append(bench_recovery(mesh))
+    print(json.dumps(rows[-1]), flush=True)
 
     # driver-visible artifact: the training numbers land in a committed
     # file each round the way the wordcount bench's land in BENCH_r*.json
     if platform == "tpu" and not smoke:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_TRAIN.json")
-        with open(out, "w") as f:
-            json.dump({"platform": platform, "metrics": rows}, f, indent=1)
-        print(f"# wrote {out}", file=sys.stderr)
+        with open(HISTORY_PATH) as f:
+            doc = json.load(f)
+        doc["platform"] = platform
+        doc["metrics"] = rows
+        with open(HISTORY_PATH, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"# wrote {HISTORY_PATH}", file=sys.stderr)
+
+    if check:
+        problems = run_check(rows)
+        if problems:
+            print("# REGRESSION GATE FAILED:", file=sys.stderr)
+            for pr in problems:
+                print(f"#   {pr}", file=sys.stderr)
+            raise SystemExit(1)
+        print(f"# regression gate passed; run appended to "
+              f"{HISTORY_PATH}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -23,6 +23,11 @@ strips ``.lua``):
   python -m mapreduce_tpu.cli diagnose CONNSTR — straggler / partition-
       skew / fault-hotspot / phase-breakdown report over the merged
       timeline (obs/analysis).
+  python -m mapreduce_tpu.cli train CONNSTR DB [--storage DSL] —
+      elastic, preemption-tolerant training: trainer lease through the
+      job board, sharded checkpoints through the blob plane,
+      resume-on-restart (fenced failover; see README "Preemption-
+      tolerant training").
 
 CONNSTR is ``mem://NAME`` (single process), ``dir:///PATH`` (shared
 directory: OS processes on one host / NFS), or ``http://HOST:PORT``
@@ -346,6 +351,159 @@ def cmd_wordcount(argv: List[str]) -> int:
     return 0
 
 
+def cmd_train(argv: List[str]) -> int:
+    """Elastic, preemption-tolerant training (the digits MLP family):
+    a trainer LEASE through the job board (coord/lease.py) so only one
+    trainer advances the state and a preempted/partitioned one fences
+    at its next step; sharded manifest-committed checkpoints through
+    the blob storage plane (models/checkpoint.py) with keep-N + best
+    retention; resume-on-restart restores the latest complete
+    checkpoint onto THIS process's mesh (reshard-on-restore).  With
+    ``--trace-out`` the flight recorder is armed: a SIGTERM'd
+    (preempted) trainer dumps its span ring + metrics snapshot to
+    ``<trace-out>.flight.*`` on the way down."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu train")
+    p.add_argument("connstr", help="job board for the trainer lease "
+                   "(mem://NAME, dir:///PATH, or http://HOST:PORT)")
+    p.add_argument("dbname")
+    p.add_argument("--storage", default=None, metavar="DSL",
+                   help="checkpoint blob plane (mem[:NAME] | "
+                        "shared:PATH | http:HOST:PORT); default: "
+                        "shared:./mrtpu_ckpt_<dbname>")
+    p.add_argument("--epochs", type=int, default=40)
+    p.add_argument("--bunch", type=int, default=32,
+                   help="per-data-shard batch size")
+    p.add_argument("--patience", type=int, default=8)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--keep", type=int, default=3, metavar="N",
+                   help="checkpoint retention: newest N plus the best")
+    p.add_argument("--lease", type=float, default=None, metavar="S",
+                   help="trainer lease seconds (default 15; heartbeats "
+                        "ride epoch boundaries, so keep this above one "
+                        "epoch + one checkpoint write)")
+    p.add_argument("--no-lease", action="store_true",
+                   help="run without the single-writer lease (solo "
+                        "runs; anything that can be preempted and "
+                        "replaced should keep it)")
+    p.add_argument("--acquire-timeout", type=float, default=None,
+                   metavar="S",
+                   help="give up if the lease is not acquired in S "
+                        "seconds (default: wait forever — the successor"
+                        "-waits-out-the-dead-holder deployment shape)")
+    p.add_argument("--holder", default=None,
+                   help="lease holder name (default: auto-generated)")
+    p.add_argument("--fresh", action="store_true",
+                   help="ignore existing checkpoints (no resume)")
+    p.add_argument("--telemetry-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="seconds between telemetry pushes (spans + "
+                        "metric snapshot, incl. the mrtpu_ckpt_* "
+                        "family the docserver's /statusz checkpoint "
+                        "section aggregates) to the board's collector "
+                        "(default 1.0; <= 0 disables; http:// boards "
+                        "only)")
+    _add_auth(p)
+    _add_retry(p)
+    _add_trace(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+    rec = _setup_trace(args)
+
+    from . import storage as storage_mod
+    from .coord import Connection, TrainerFencedError, TrainerLease
+    from .coord.lease import DEFAULT_TRAINER_LEASE
+    from .models import (
+        DistributedTrainer, MLPConfig, TrainConfig, make_digits)
+    from .models.checkpoint import CheckpointManager
+    from .obs.collector import acquire_pusher, release_pusher
+    from .parallel import make_mesh
+
+    storage_dsl = args.storage or f"shared:mrtpu_ckpt_{args.dbname}"
+    manager = CheckpointManager(
+        storage_mod.router(storage_dsl, auth=args.auth),
+        keep_n=args.keep)
+    cnn = Connection(args.connstr, args.dbname, auth=args.auth,
+                     retry=_retry_policy(args))
+    lease = None
+    if not args.no_lease:
+        lease = TrainerLease(cnn, holder=args.holder,
+                             lease=args.lease or DEFAULT_TRAINER_LEASE)
+        try:
+            gen = lease.acquire(timeout=args.acquire_timeout)
+        except TimeoutError as exc:
+            print(str(exc), file=sys.stderr)
+            return 2
+        print(f"trainer lease acquired (holder {lease.holder}, "
+              f"generation {gen})", file=sys.stderr, flush=True)
+    # telemetry (http boards only): the ckpt/lease counters live in
+    # THIS process — pushing them is what makes the docserver's
+    # /statusz checkpoint section non-empty in the split deployment
+    tele = acquire_pusher(
+        cnn.board_hostport(), cnn.auth_token(),
+        role=f"trainer:{lease.holder if lease else args.dbname}",
+        interval=args.telemetry_interval)
+
+    def log(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
+    try:
+        try:
+            # setup runs INSIDE the release-on-crash scope: a mesh/data
+            # construction failure after acquire must hand the lease
+            # back like any other non-fence crash
+            cfg = TrainConfig(max_epochs=args.epochs,
+                              bunch_size=args.bunch,
+                              patience=args.patience, seed=args.seed,
+                              keep_checkpoints=args.keep)
+            trainer = DistributedTrainer(make_mesh(), MLPConfig(), cfg)
+            x_tr, y_tr, x_va, y_va = make_digits(seed=args.seed)
+            out = trainer.fit(x_tr, y_tr, x_va, y_va, log=log,
+                              manager=manager, lease=lease,
+                              resume=not args.fresh)
+        except TrainerFencedError as exc:
+            # fenced: a successor owns the lineage now.  Exit distinctly
+            # (and WITHOUT releasing — we hold nothing) so orchestrators
+            # can tell preemption-fencing from failure.
+            print(f"FENCED: {exc}", file=sys.stderr)
+            _export_trace(args, rec)
+            return 3
+        except BaseException:
+            # any OTHER failure (storage error, Ctrl-C) still holds the
+            # lease: hand it off so a standby claims immediately instead
+            # of waiting out the expiry on every crash of a restart
+            # loop.  No trace export here — the flight recorder's
+            # abnormal-exit dump is the signal for this path, and a
+            # normal export would disarm it.
+            if lease is not None:
+                try:
+                    lease.release()
+                except OSError:
+                    pass  # board unreachable: lease expires on its own
+            raise
+        if lease is not None:
+            # clean exit: successor claims with no wait.  A transport
+            # error here must not turn a finished run into a failure —
+            # the lease expires on its own.
+            try:
+                lease.release()
+            except OSError:
+                pass
+        print(json.dumps({
+            "epochs_run": out["epochs_run"],
+            "start_epoch": out["start_epoch"],
+            "restored": out["restored"], "best_epoch": out["best_epoch"],
+            "best_val_loss": out["best_val_loss"],
+            "checkpoints": manager.steps(), "best": manager.best_step(),
+            "storage": storage_dsl}, default=float))
+        _export_trace(args, rec)
+        return 0
+    finally:
+        # final flush: the closing metric snapshot (total saves, last
+        # step, any fence) reaches the collector on every exit path
+        release_pusher(tele)
+
+
 def cmd_blobserver(argv: List[str]) -> int:
     """Serve a directory as the ``http:HOST:PORT`` storage backend — the
     central blob service workers on other hosts point their storage DSL
@@ -503,11 +661,32 @@ def _render_telemetry(tele: dict) -> List[str]:
     return lines
 
 
+def _render_checkpoint(ck: dict) -> List[str]:
+    """The training-plane section of /statusz: checkpoint save/restore/
+    corruption counters and the last recovery time (obs/statusz
+    checkpoint_snapshot)."""
+    if not ck:
+        return []
+    line = ("checkpoints: {:.0f} saved (last step {:.0f}) | restores "
+            "{:.0f} ok / {:.0f} corrupt ({:.0f} bad shards, {:.0f} "
+            "fallbacks) | {:.0f} gc'd | {:.0f} fences".format(
+                ck.get("saves", 0), ck.get("last_saved_step", 0),
+                ck.get("restores_ok", 0), ck.get("restores_corrupt", 0),
+                ck.get("corrupt_shards", 0), ck.get("fallbacks", 0),
+                ck.get("gc", 0), ck.get("lease_fences", 0)))
+    out = [line]
+    if ck.get("recovery_s"):
+        out.append("  last step-recovery: {:.3f}s".format(
+            ck["recovery_s"]))
+    return out
+
+
 def render_status(snap: dict) -> str:
     """One-screen text view of a /statusz snapshot (the master status
     page role, Dean & Ghemawat §4.6)."""
     lines: List[str] = _render_build(snap.get("build") or {})
     lines += _render_device(snap.get("device") or {})
+    lines += _render_checkpoint(snap.get("checkpoint") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
     tasks = snap.get("tasks", {})
     if not tasks:
@@ -525,6 +704,14 @@ def render_status(snap: dict) -> str:
                 continue
             parts = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
             lines.append(f"  {phase:<7}{total} jobs: {parts}")
+        tl = t.get("trainer")
+        if tl:
+            lines.append(
+                "  trainer lease: {} (generation {}, {}, lease "
+                "{:+.1f}s)".format(
+                    tl.get("holder") or "FREE", tl.get("generation"),
+                    "HELD" if tl.get("held") else "free/expired",
+                    tl.get("lease_expires_in") or 0.0))
         workers = t.get("workers", {})
         if workers:
             for name, w in sorted(workers.items()):
@@ -871,7 +1058,7 @@ COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "blobserver": cmd_blobserver, "docserver": cmd_docserver,
             "warmup": cmd_warmup, "status": cmd_status,
             "profile": cmd_profile, "timeline": cmd_timeline,
-            "diagnose": cmd_diagnose}
+            "diagnose": cmd_diagnose, "train": cmd_train}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,560 @@
+"""Sharded, manifest-committed training-state checkpoints on the blob
+storage planes.
+
+The reference's trainer snapshot is one serialized blob through GridFS
+per iteration (common.lua:191); the old ``models/trainer.py`` mirror was
+one fully-replicated local npz overwritten in place.  Neither survives
+production: a preempted trainer needs durable state it can restore FROM
+A DIFFERENT PROCESS, ON A DIFFERENT MESH, through whatever blob plane
+the deployment runs (``storage/router.py``: localdir, http, mem).
+
+Layout (one checkpoint = one directory-shaped blob prefix)::
+
+    <prefix>ckpt-00000012/<quoted leaf path>.<shard>.npy   # npy bytes
+    <prefix>ckpt-00000012/MANIFEST.json                    # written LAST
+    <prefix>BEST                                           # best-step tag
+
+* **Per-shard blobs**: every leaf is saved as its device shards
+  (deduped by global index, so replicated axes store once) — each
+  host uploads only what it can address, and a multi-GB state never
+  materialises as one buffer.
+* **Manifest-last atomic commit**: the manifest names every shard with
+  its global index, dtype/shape, byte length and sha256.  A checkpoint
+  without a parseable manifest does not exist; a kill between shard
+  write and manifest write leaves the previous checkpoint authoritative.
+* **Corruption-safe restore**: every shard is digest-verified on read;
+  a truncated/garbled/missing shard fails that checkpoint and
+  :func:`restore_latest` falls back to the previous complete one,
+  counting the event in ``mrtpu_ckpt_*``.
+* **Reshard-on-restore**: restore takes the TARGET mesh + the regex
+  partition rules (parallel/partition.py); shards are assembled into
+  the global array and re-laid-out by the rule-resolved spec — a run
+  saved on 8 devices resumes on 4, or on a different 2-D mesh,
+  value-identically.
+* **Retention**: :class:`CheckpointManager` keeps the newest ``keep_n``
+  plus the marked best (the reference's best/last pair, with history).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import hashlib
+import io
+import json
+import re
+import urllib.parse
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import tree_unflatten
+
+from ..obs import metrics as _metrics
+from ..parallel.partition import Rules, flatten_with_names, resolve_spec
+from ..storage.base import Storage
+
+MANIFEST = "MANIFEST.json"
+BEST_TAG = "BEST"
+FORMAT = 1
+
+_SAVES = _metrics.counter(
+    "mrtpu_ckpt_saves_total",
+    "sharded checkpoints committed (manifest written)")
+_RESTORES = _metrics.counter(
+    "mrtpu_ckpt_restores_total",
+    "checkpoint restore attempts (labels: outcome=ok|corrupt)")
+_CORRUPT_SHARDS = _metrics.counter(
+    "mrtpu_ckpt_corrupt_shards_total",
+    "shards that failed digest/size/decode validation on restore")
+_FALLBACKS = _metrics.counter(
+    "mrtpu_ckpt_fallbacks_total",
+    "restores that fell back past a bad/incomplete checkpoint to an "
+    "older complete one")
+_GC = _metrics.counter(
+    "mrtpu_ckpt_gc_total",
+    "checkpoint data removed by gc (labels: reason=retention for "
+    "whole checkpoints beyond keep-N, reason=orphan for manifestless "
+    "shard dirs left by aborted commits)")
+_BYTES = _metrics.counter(
+    "mrtpu_ckpt_bytes_total",
+    "checkpoint shard payload bytes (labels: direction=save|restore)")
+_LAST_STEP = _metrics.gauge(
+    "mrtpu_ckpt_last_step",
+    "step of the newest committed checkpoint this process wrote or "
+    "restored (labels: op=save|restore)")
+
+
+class CheckpointError(ValueError):
+    """Typed checkpoint failure: missing/mismatched leaves, no complete
+    checkpoint, unusable manifest.  A ValueError so legacy callers
+    catching that still work — but never a bare KeyError from deep
+    inside a training loop."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A specific checkpoint's payload failed validation (truncated or
+    garbled shard, digest mismatch, unparseable manifest).  Restore
+    policy: fall back to the previous complete checkpoint."""
+
+
+# --- naming -----------------------------------------------------------------
+
+
+def checkpoint_dir(prefix: str, step: int) -> str:
+    return f"{prefix}ckpt-{int(step):08d}"
+
+
+def manifest_name(prefix: str, step: int) -> str:
+    return f"{checkpoint_dir(prefix, step)}/{MANIFEST}"
+
+
+def _shard_blob(dirname: str, leaf: str, j: int) -> str:
+    return f"{dirname}/{urllib.parse.quote(leaf, safe='')}.{j}.npy"
+
+
+def list_steps(storage: Storage, prefix: str = "") -> List[int]:
+    """Steps with a manifest PRESENT under *prefix*, ascending.  Presence
+    is the commit signal; parseability is checked at restore."""
+    rx = (f"^{re.escape(prefix)}ckpt-(\\d{{8}})/"
+          f"{re.escape(MANIFEST)}$")
+    steps = []
+    for name in storage.list(rx):
+        m = re.search(rx, name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(set(steps))
+
+
+# --- save -------------------------------------------------------------------
+
+
+def _leaf_shards(leaf: Any) -> List[Tuple[Tuple[Tuple[int, int], ...],
+                                          np.ndarray]]:
+    """This process's addressable shards of *leaf*, deduped by global
+    index (replicated placements store one copy), as
+    ``[(((start, stop), ...), np_array), ...]`` sorted by index.  A
+    plain numpy/scalar leaf is one full-extent shard."""
+    shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        seen: Dict[Tuple[Tuple[int, int], ...], np.ndarray] = {}
+        for s in leaf.addressable_shards:
+            idx = tuple(
+                (sl.start or 0, sl.stop if sl.stop is not None else dim)
+                for sl, dim in zip(s.index, shape))
+            if idx not in seen:
+                seen[idx] = np.asarray(s.data)
+        return sorted(seen.items())
+    arr = np.asarray(leaf)
+    return [(tuple((0, d) for d in arr.shape), arr)]
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    # order="C" (not ascontiguousarray, which PROMOTES 0-d to 1-d and
+    # would break the manifest's shape contract for scalar leaves)
+    np.save(buf, np.asarray(arr, order="C"), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _spec_doc(spec: Optional[P]) -> Optional[List[Any]]:
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def save(storage: Storage, step: int, tree: Any, rules: Optional[Rules]
+         = None, prefix: str = "", meta: Optional[Dict[str, Any]] = None,
+         precommit: Optional[Any] = None) -> str:
+    """Write one sharded checkpoint; returns the manifest blob name.
+
+    Shards first, manifest LAST — the manifest is the atomic commit
+    point, so a crash mid-save leaves no half-checkpoint a restore
+    could mistake for complete.  *rules* (when given) are resolved per
+    leaf and recorded in the manifest for operators; restore resolves
+    its own placement from the restoring process's rules and mesh.
+
+    *precommit* (when given) is called immediately before the manifest
+    publish — AFTER the potentially long shard upload — and aborts the
+    commit by raising.  The fenced trainer passes its lease gate here,
+    shrinking the stale-writer window from the whole upload to one blob
+    write (a same-step commit that still slips through that residual
+    window is value-identical by the trainer's ``seed + epoch``
+    determinism contract).
+
+    Single-controller scope: this process writes the shards IT can
+    address plus the manifest; under multi-process ``jax.distributed``
+    every process must call this (same prefix/step) and the LAST writer
+    of the manifest wins — per-process manifest merge is future work.
+    """
+    named, _ = flatten_with_names(tree)
+    dirname = checkpoint_dir(prefix, step)
+
+    def put_leaf(name: str, leaf: Any) -> Tuple[str, Dict[str, Any]]:
+        spec = resolve_spec(rules, name, leaf) if rules is not None \
+            else None
+        shards = []
+        for j, (idx, arr) in enumerate(_leaf_shards(leaf)):
+            data = _npy_bytes(arr)
+            blob = _shard_blob(dirname, name, j)
+            storage.write_bytes(blob, data)
+            _BYTES.inc(len(data), direction="save")
+            shards.append({
+                "blob": blob,
+                "index": [list(p) for p in idx],
+                "nbytes": len(data),
+                "sha256": hashlib.sha256(data).hexdigest(),
+            })
+        return name, {
+            "shape": list(getattr(leaf, "shape", np.shape(leaf))),
+            "dtype": str(np.dtype(getattr(leaf, "dtype", None)
+                                  or np.asarray(leaf).dtype)),
+            "spec": _spec_doc(spec),
+            "shards": shards,
+        }
+
+    if len(named) > 1 and getattr(storage, "scheme", None) == "http":
+        # fan the per-leaf uploads out over the blob client's connection
+        # pool (the coord/job.py map-PUT pattern): the commit — and the
+        # stale-writer window the precommit hook narrows — should wait
+        # on the SLOWEST transfer, not the sum of all of them; local
+        # backends gain nothing from threads, so they keep the serial
+        # loop
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(len(named), 8)) as ex:
+            leaves = dict(ex.map(lambda nl: put_leaf(*nl), named))
+    else:
+        leaves = dict(put_leaf(name, leaf) for name, leaf in named)
+    doc = {"format": FORMAT, "step": int(step), "meta": meta or {},
+           "leaves": leaves}
+    mname = manifest_name(prefix, step)
+    if precommit is not None:
+        precommit()  # last abort point before the checkpoint EXISTS
+    storage.write(mname, json.dumps(doc, sort_keys=True))  # THE commit
+    _SAVES.inc()
+    _LAST_STEP.set(int(step), op="save")
+    return mname
+
+
+# --- restore ----------------------------------------------------------------
+
+
+def load_manifest(storage: Storage, prefix: str, step: int,
+                  ) -> Dict[str, Any]:
+    """Read + structurally validate one manifest; corrupt/missing ->
+    :class:`CheckpointCorruptError` (fallback-eligible)."""
+    mname = manifest_name(prefix, step)
+    try:
+        doc = json.loads(storage.read(mname))
+    except (FileNotFoundError, KeyError) as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint step {step}: manifest missing ({exc})") from exc
+    except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint step {step}: manifest unparseable "
+            f"({exc})") from exc
+    if (not isinstance(doc, dict) or doc.get("format") != FORMAT
+            or doc.get("step") != int(step)
+            or not isinstance(doc.get("meta"), dict)
+            or not isinstance(doc.get("leaves"), dict)):
+        raise CheckpointCorruptError(
+            f"checkpoint step {step}: manifest malformed")
+    # structural validation of every leaf entry: a garbled-but-JSON
+    # manifest must be CORRUPT (fallback-eligible), not a KeyError
+    # three frames deep in assemble_leaf
+    name = "?"
+    try:
+        for name, entry in doc["leaves"].items():
+            shape = tuple(int(d) for d in entry["shape"])
+            np.dtype(entry["dtype"])
+            for sh in entry["shards"]:
+                if not isinstance(sh["blob"], str):
+                    raise TypeError(f"blob {sh['blob']!r}")
+                str(sh["sha256"])
+                int(sh["nbytes"])
+                idx = [(int(a), int(b)) for a, b in sh["index"]]
+                if len(idx) != len(shape) or any(
+                        not 0 <= a <= b <= d
+                        for (a, b), d in zip(idx, shape)):
+                    raise ValueError(
+                        f"shard index {idx} outside shape {shape}")
+    except (KeyError, TypeError, ValueError) as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint step {step}: manifest structurally invalid "
+            f"(leaf {name!r}: {exc!r})") from exc
+    return doc
+
+
+def _read_shard(storage: Storage, name: str, sh: Dict[str, Any],
+                ) -> Tuple[np.ndarray, int]:
+    """Fetch + digest-verify + decode ONE shard -> (array, nbytes);
+    any failure is CheckpointCorruptError."""
+    try:
+        data = storage.read_bytes(sh["blob"])
+    except (FileNotFoundError, KeyError) as exc:
+        _CORRUPT_SHARDS.inc()
+        raise CheckpointCorruptError(
+            f"leaf {name!r}: shard {sh['blob']!r} missing") from exc
+    if (len(data) != sh["nbytes"]
+            or hashlib.sha256(data).hexdigest() != sh["sha256"]):
+        _CORRUPT_SHARDS.inc()
+        raise CheckpointCorruptError(
+            f"leaf {name!r}: shard {sh['blob']!r} failed digest/size "
+            f"validation ({len(data)} bytes)")
+    try:
+        arr = np.load(io.BytesIO(data), allow_pickle=False)
+    except ValueError as exc:
+        _CORRUPT_SHARDS.inc()
+        raise CheckpointCorruptError(
+            f"leaf {name!r}: shard {sh['blob']!r} undecodable "
+            f"({exc})") from exc
+    return arr, len(data)
+
+
+def assemble_leaf(storage: Storage, name: str, entry: Dict[str, Any],
+                  ) -> np.ndarray:
+    """Read + verify + place every shard of one leaf into its global
+    array.  Digest/size/extent failures -> CheckpointCorruptError."""
+    shape = tuple(int(d) for d in entry["shape"])
+    dtype = np.dtype(entry["dtype"])
+    out = np.empty(shape, dtype)
+    covered = 0
+    shards = entry["shards"]
+    if len(shards) > 1 and getattr(storage, "scheme", None) == "http":
+        # the N per-device shards of one leaf are independent GETs —
+        # overlap them on the networked plane (this is the round-trip
+        # sum the gated trainer_recovery_s pays); placement into the
+        # global array stays in this thread
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(len(shards), 8)) as ex:
+            fetched = list(ex.map(
+                lambda sh: _read_shard(storage, name, sh), shards))
+    else:
+        fetched = [_read_shard(storage, name, sh) for sh in shards]
+    for sh, (arr, nbytes) in zip(shards, fetched):
+        idx = tuple(slice(int(a), int(b)) for a, b in sh["index"])
+        extent = tuple(int(b) - int(a) for a, b in sh["index"])
+        if arr.shape != extent or arr.dtype != dtype:
+            _CORRUPT_SHARDS.inc()
+            raise CheckpointCorruptError(
+                f"leaf {name!r}: shard {sh['blob']!r} is "
+                f"{arr.shape}/{arr.dtype}, manifest says "
+                f"{extent}/{dtype}")
+        out[idx] = arr
+        covered += int(np.prod(extent)) if extent else 1
+        _BYTES.inc(nbytes, direction="restore")
+    total = int(np.prod(shape)) if shape else 1
+    if covered != total:
+        raise CheckpointCorruptError(
+            f"leaf {name!r}: shards cover {covered} of {total} elements")
+    return out
+
+
+def note_restore(outcome: str, step: Optional[int] = None,
+                 fell_past: int = 0) -> None:
+    """Metric hook for custom restore flows built on
+    :func:`assemble_leaf` (the transformer's arch-gated loader): count
+    one restore attempt.  With ``ok``, *step* records the restored step
+    and *fell_past* how many corrupt candidates the successful restore
+    skipped — fallbacks count only when something was actually fallen
+    back TO, so a total restore failure never reads as N successful
+    fallbacks."""
+    _RESTORES.inc(outcome=outcome)
+    if outcome == "ok":
+        if fell_past:
+            _FALLBACKS.inc(fell_past)
+        if step is not None:
+            _LAST_STEP.set(int(step), op="restore")
+
+
+def validate_manifest_against(manifest: Dict[str, Any], template: Any,
+                              ) -> None:
+    """Every expected leaf present with the expected shape/dtype, no
+    extras — the typed gate a restore runs BEFORE touching payload, so
+    a wrong-config resume fails with names, not a KeyError mid-``fit``.
+    """
+    named, _ = flatten_with_names(template)
+    want = {name: (tuple(getattr(leaf, "shape", np.shape(leaf))),
+                   np.dtype(getattr(leaf, "dtype", None)
+                            or np.asarray(leaf).dtype))
+            for name, leaf in named}
+    got = manifest["leaves"]
+    missing = sorted(set(want) - set(got))
+    extra = sorted(set(got) - set(want))
+    if missing or extra:
+        raise CheckpointError(
+            "checkpoint state does not match this trainer: "
+            + (f"missing leaves {missing}" if missing else "")
+            + (" " if missing and extra else "")
+            + (f"unexpected leaves {extra}" if extra else ""))
+    bad = []
+    for name, (shape, dtype) in want.items():
+        e = got[name]
+        if (tuple(int(d) for d in e["shape"]) != shape
+                or np.dtype(e["dtype"]) != dtype):
+            bad.append(f"{name} {tuple(e['shape'])}/{e['dtype']} vs "
+                       f"{shape}/{dtype}")
+    if bad:
+        raise CheckpointError(
+            "checkpoint state does not match this trainer "
+            "(shape/dtype): " + ", ".join(bad))
+
+
+def restore(storage: Storage, template: Any, step: int,
+            mesh: Optional[Mesh] = None, rules: Optional[Rules] = None,
+            prefix: str = "") -> Tuple[Any, Dict[str, Any]]:
+    """Restore ONE checkpoint into *template*'s tree structure; returns
+    ``(state_tree, manifest)``.
+
+    With *mesh* + *rules*, every leaf is ``device_put`` with its
+    rule-resolved ``NamedSharding`` on the TARGET mesh — whatever mesh
+    the checkpoint was saved under (reshard-on-restore).  Without them,
+    leaves come back as host numpy arrays."""
+    manifest = load_manifest(storage, prefix, step)
+    validate_manifest_against(manifest, template)
+    named, treedef = flatten_with_names(template)
+    placed = []
+    for name, leaf in named:
+        arr = assemble_leaf(storage, name, manifest["leaves"][name])
+        if mesh is not None and rules is not None:
+            arr = jax.device_put(
+                arr, NamedSharding(mesh, resolve_spec(rules, name, arr)))
+        placed.append(arr)
+    _RESTORES.inc(outcome="ok")
+    _LAST_STEP.set(int(manifest["step"]), op="restore")
+    return tree_unflatten(treedef, placed), manifest
+
+
+def restore_latest(storage: Storage, template: Any,
+                   mesh: Optional[Mesh] = None,
+                   rules: Optional[Rules] = None, prefix: str = "",
+                   ) -> Optional[Tuple[Any, Dict[str, Any]]]:
+    """Restore the newest COMPLETE checkpoint, falling back past
+    corrupt/incomplete ones (counted) — None when no checkpoint exists
+    at all.  A config mismatch (:class:`CheckpointError` that is not
+    corruption) does NOT fall back: restoring an older checkpoint
+    cannot fix a wrong template and would hide the real problem."""
+    steps = list_steps(storage, prefix)
+    skipped = 0
+    for step in reversed(steps):
+        try:
+            out = restore(storage, template, step, mesh=mesh,
+                          rules=rules, prefix=prefix)
+        except CheckpointCorruptError:
+            note_restore("corrupt")
+            skipped += 1
+            continue
+        if skipped:
+            # counted only now: a fallback is falling back TO something
+            _FALLBACKS.inc(skipped)
+        return out
+    if steps:
+        raise CheckpointError(
+            f"no complete checkpoint under {prefix!r}: all "
+            f"{len(steps)} candidates failed validation")
+    return None
+
+
+# --- retention --------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Retention-managed checkpoint stream on one storage prefix: save
+    every step, keep the newest *keep_n* plus the marked best.
+
+    Storage-plane agnostic (anything :func:`~..storage.router` opens);
+    restore placement (mesh + rules) is the caller's, passed per call,
+    so one manager serves save-side and restore-side processes alike.
+    """
+
+    def __init__(self, storage: Storage, prefix: str = "",
+                 keep_n: int = 3) -> None:
+        if keep_n < 1:
+            raise ValueError("keep_n must be >= 1")
+        self.storage = storage
+        self.prefix = prefix
+        self.keep_n = keep_n
+
+    # -- save side ------------------------------------------------------
+
+    def save(self, step: int, tree: Any, rules: Optional[Rules] = None,
+             meta: Optional[Dict[str, Any]] = None, gc: bool = True,
+             precommit: Optional[Any] = None) -> str:
+        name = save(self.storage, step, tree, rules=rules,
+                    prefix=self.prefix, meta=meta, precommit=precommit)
+        if gc:
+            self.gc()
+        return name
+
+    def mark_best(self, step: int) -> None:
+        """Tag *step* as best (atomic publish); retention keeps it."""
+        self.storage.write(self.prefix + BEST_TAG, str(int(step)))
+
+    def best_step(self) -> Optional[int]:
+        try:
+            return int(self.storage.read(self.prefix + BEST_TAG).strip())
+        except (FileNotFoundError, KeyError, ValueError):
+            return None
+
+    def steps(self) -> List[int]:
+        return list_steps(self.storage, self.prefix)
+
+    def gc(self) -> int:
+        """Drop checkpoints beyond retention: manifest FIRST (the
+        checkpoint atomically stops existing), then its shards; returns
+        the number of CHECKPOINTS removed.  Also reclaims ORPHANED
+        shard dirs — shards without a manifest at a step below the
+        newest committed one (an aborted/fenced commit, or a previous
+        gc that died between manifest remove and shard remove).  Such a
+        step can never become a checkpoint: any writer that would
+        complete it is stale by the fencing contract.  Manifestless
+        shards ABOVE the newest step are left alone — they may be a
+        commit in flight.  ONE listing RPC serves both passes — this
+        runs per epoch commit, so the steady no-op state must stay
+        cheap on a networked blob plane."""
+        rx = re.compile(f"^{re.escape(self.prefix)}" + r"ckpt-(\d{8})/")
+        by_step: Dict[int, List[str]] = {}
+        for name in self.storage.list(rx.pattern):
+            m = rx.match(name)
+            if m:
+                by_step.setdefault(int(m.group(1)), []).append(name)
+        steps = sorted(s for s in by_step
+                       if manifest_name(self.prefix, s) in by_step[s])
+        if not steps:
+            return 0
+        keep = set(steps[-self.keep_n:])
+        best = self.best_step()
+        if best is not None:
+            keep.add(best)
+        removed = 0
+        for step in steps:
+            if step in keep:
+                continue
+            mname = manifest_name(self.prefix, step)
+            self.storage.remove(mname)
+            self.storage.remove_many(
+                [n for n in by_step[step] if n != mname])
+            removed += 1
+            _GC.inc(reason="retention")
+        committed = set(steps)
+        for s in sorted(by_step):
+            if s not in committed and s < steps[-1]:
+                self.storage.remove_many(by_step[s])
+                _GC.inc(reason="orphan")
+        return removed
+
+    # -- restore side ---------------------------------------------------
+
+    def restore_latest(self, template: Any, mesh: Optional[Mesh] = None,
+                       rules: Optional[Rules] = None,
+                       ) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        return restore_latest(self.storage, template, mesh=mesh,
+                              rules=rules, prefix=self.prefix)
+
+    def restore_step(self, template: Any, step: int,
+                     mesh: Optional[Mesh] = None,
+                     rules: Optional[Rules] = None,
+                     ) -> Tuple[Any, Dict[str, Any]]:
+        return restore(self.storage, template, step, mesh=mesh,
+                       rules=rules, prefix=self.prefix)

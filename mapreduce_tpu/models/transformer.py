@@ -486,7 +486,8 @@ class TransformerTrainer:
         return self._train_step_opt(params, opt_state, x, y)
 
     # -- checkpointing (the reference's GridFS-serialized trainer role,
-    # common.lua:24-39; shares the MLP trainer's atomic npz format) -----
+    # common.lua:24-39; rides the sharded manifest-committed layer of
+    # models/checkpoint.py — per-shard blobs, manifest written last) ---
 
     def _arch_tag(self) -> str:
         """Canonical architecture string — catches same-shape scrambles
@@ -497,45 +498,71 @@ class TransformerTrainer:
                 f"d{c.head_dim}.f{c.ffn}.moe{c.moe_experts}")
 
     def save(self, path: str, params: Params, step: int = 0,
-             opt_state=None) -> None:
-        """Write an atomic npz (save_checkpoint gathers to host); pass
-        ``opt_state`` to carry the optimizer moments too (flattened
-        leaves — the treedef is regenerated from tx.init at load).
-        Single-controller: under multi-process ``jax.distributed`` the
-        shards on other hosts aren't addressable here — gather with
-        multihost utils before calling, or save per-process shards."""
-        from .trainer import save_checkpoint
+             opt_state=None, keep: int = 3) -> None:
+        """Commit a sharded, manifest-committed checkpoint under the
+        *path* directory (models/checkpoint.py: per-shard npy blobs,
+        manifest written last as the atomic commit point).  Pass
+        ``opt_state`` to carry the optimizer moments too; the treedef
+        attestation travels in the manifest meta.  Retention: only the
+        newest *keep* checkpoints survive, so a save-every-epoch caller
+        uses bounded disk like the old overwrite-in-place npz did.
+        Each process writes only its addressable shards — under
+        multi-process ``jax.distributed`` every process calls this with
+        the same path/step."""
+        from ..storage.localdir import LocalDirStorage
+        from . import checkpoint as ckpt
 
-        host = dict(params)
-        host["__arch__"] = np.frombuffer(
-            self._arch_tag().encode(), dtype=np.uint8)
+        tree: Dict[str, Any] = {"params": dict(params)}
+        meta: Dict[str, Any] = {"arch": self._arch_tag()}
         if opt_state is not None:
-            for i, leaf in enumerate(jax.tree.leaves(opt_state)):
-                host[f"__opt__{i}"] = leaf
-            host["__opttree__"] = np.frombuffer(
-                str(jax.tree.structure(opt_state)).encode(),
-                dtype=np.uint8)
-        save_checkpoint(path, host, step)
+            tree["opt"] = opt_state
+            meta["opt_tree"] = str(jax.tree.structure(opt_state))
+        ckpt.CheckpointManager(LocalDirStorage(path), keep_n=keep).save(
+            step, tree, meta=meta)
 
     def _load_host(self, path: str):
-        """-> (validated host params dict, opt leaves, opt treedef str
-        or None, step)."""
-        from .trainer import load_checkpoint
+        """-> (validated host params dict, opt tree or None, opt treedef
+        str or None, step) from the newest COMPLETE checkpoint under
+        *path* — every leaf digest-verified and assembled from its
+        shards.  A corrupt manifest or shard falls back to the previous
+        complete checkpoint (counted in ``mrtpu_ckpt_*``, same policy
+        as :func:`checkpoint.restore_latest`); an arch/name/shape
+        mismatch raises immediately — an older checkpoint cannot fix a
+        wrong config."""
+        from ..storage.localdir import LocalDirStorage
+        from . import checkpoint as ckpt
 
-        host, step = load_checkpoint(path)
-        opt_tree = host.pop("__opttree__", None)
-        opt_leaves = [host.pop(k) for k in sorted(
-            (k for k in host if k.startswith("__opt__")),
-            key=lambda k: int(k[len("__opt__"):]))]
-        opt_tree_s = (bytes(bytearray(opt_tree)).decode()
-                      if opt_tree is not None else None)
-        arch = host.pop("__arch__", None)
-        if arch is not None:
-            got = bytes(bytearray(arch)).decode()
-            if got != self._arch_tag():
-                raise ValueError(
-                    f"checkpoint params do not match this config: "
-                    f"checkpoint arch {got}, trainer {self._arch_tag()}")
+        storage = LocalDirStorage(path)
+        steps = ckpt.list_steps(storage)
+        skipped = 0
+        for step in reversed(steps):
+            try:
+                manifest = ckpt.load_manifest(storage, "", step)
+                got = (manifest.get("meta") or {}).get("arch")
+                if got != self._arch_tag():
+                    raise ValueError(
+                        f"checkpoint params do not match this config: "
+                        f"checkpoint arch {got}, trainer "
+                        f"{self._arch_tag()}")
+                out = self._host_from_manifest(storage, manifest)
+            except ckpt.CheckpointCorruptError:
+                ckpt.note_restore("corrupt")
+                skipped += 1
+                continue
+            ckpt.note_restore("ok", step, fell_past=skipped)
+            return out
+        raise ckpt.CheckpointError(
+            f"no complete checkpoint found ({len(steps)} candidates)")
+
+    def _host_from_manifest(self, storage, manifest):
+        """Validate one manifest against this config and assemble its
+        leaves (mismatch -> ValueError, bad payload ->
+        CheckpointCorruptError for the caller's fallback loop)."""
+        from . import checkpoint as ckpt
+
+        leaves = manifest["leaves"]
+        host = {n[len("params/"):]: e for n, e in leaves.items()
+                if n.startswith("params/")}
         missing = set(self._pspecs) ^ set(host)
         if missing:
             raise ValueError(
@@ -543,14 +570,22 @@ class TransformerTrainer:
         ref = jax.eval_shape(
             lambda: init_transformer(jax.random.key(0), self.cfg))
         bad = [n for n in self._pspecs
-               if host[n].shape != ref[n].shape
-               or host[n].dtype != ref[n].dtype]
+               if tuple(host[n]["shape"]) != ref[n].shape
+               or np.dtype(host[n]["dtype"]) != ref[n].dtype]
         if bad:
             raise ValueError(
                 "checkpoint params do not match this config (shape/dtype): "
-                + ", ".join(f"{n} {host[n].shape}/{host[n].dtype} vs "
+                + ", ".join(f"{n} {tuple(host[n]['shape'])}/"
+                            f"{host[n]['dtype']} vs "
                             f"{ref[n].shape}/{ref[n].dtype}" for n in bad))
-        return host, opt_leaves, opt_tree_s, step
+        params = {n: ckpt.assemble_leaf(storage, n, host[n])
+                  for n in self._pspecs}
+        opt_names = sorted(n for n in leaves if n.startswith("opt/"))
+        opt = ({n: ckpt.assemble_leaf(storage, n, leaves[n])
+                for n in opt_names}
+               if opt_names else None)
+        opt_tree_s = (manifest.get("meta") or {}).get("opt_tree")
+        return params, opt, opt_tree_s, int(manifest["step"])
 
     def _place_params(self, host) -> Params:
         return {n: jax.device_put(
@@ -558,7 +593,7 @@ class TransformerTrainer:
                 for n in self._pspecs}
 
     def load(self, path: str) -> Tuple[Params, int]:
-        """Load an npz checkpoint and re-place every tensor with its
+        """Load a checkpoint and re-place every tensor with its
         tp-sharding on this trainer's mesh (a checkpoint saved on one
         mesh layout restores onto another — resharding is just
         device_put with the new NamedSharding).  Rejects checkpoints
@@ -576,17 +611,20 @@ class TransformerTrainer:
         ``tx.init`` (no device allocation), then the saved leaves place
         with the same mesh rules as fresh state; a checkpoint saved
         without optimizer state resumes with FRESH moments."""
+        from ..parallel.partition import flatten_with_names
+
         self._need_tx()
-        host, leaves, saved_tree, step = self._load_host(path)
+        host, opt_host, saved_tree, step = self._load_host(path)
         params = self._place_params(host)
-        if not leaves:
+        if opt_host is None:
             return params, self._opt_init(params), step
         template = jax.eval_shape(self.tx.init, params)
-        t_leaves = jax.tree.leaves(template)
-        if len(leaves) != len(t_leaves):
+        named, treedef = flatten_with_names(template)
+        want_names = ["opt/" + n for n, _ in named]
+        if sorted(want_names) != sorted(opt_host):
             raise ValueError(
                 f"checkpoint optimizer state does not match: "
-                f"{len(leaves)} leaves saved, {len(t_leaves)} expected")
+                f"{len(opt_host)} leaves saved, {len(named)} expected")
         # treedef attestation: moments from a structurally-DIFFERENT
         # optimizer are rejected by name (ScaleByAdamState vs
         # FactoredState ...).  Structurally identical optimizers are
@@ -596,8 +634,9 @@ class TransformerTrainer:
         if saved_tree is not None and saved_tree != want:
             raise ValueError(
                 "checkpoint optimizer state does not match this "
-                f"trainer's optimizer: saved {saved_tree}, "
-                f"expected {want}")
-        cast = [leaf.astype(t.dtype) for leaf, t in zip(leaves, t_leaves)]
-        state = jax.tree.unflatten(jax.tree.structure(template), cast)
+                "trainer's optimizer: saved " + saved_tree +
+                f", expected {want}")
+        cast = [opt_host["opt/" + n].astype(t.dtype)
+                for (n, t) in named]
+        state = jax.tree.unflatten(treedef, cast)
         return params, self._place_opt_state(state), step

@@ -173,11 +173,19 @@ def append_history(path: str, entry: Dict[str, Any],
 
 def check_and_append(path: str, current: Dict[str, Any],
                      specs: List[MetricSpec], key: str = "history",
-                     append: bool = True) -> List[str]:
+                     append: bool = True,
+                     match=None) -> List[str]:
     """The bench scripts' one-call flow: gate *current* against the
     file's history; on pass (and *append*) record it.  Returns the
-    regression list (empty = accepted)."""
+    regression list (empty = accepted).
+
+    *match* (entry -> bool) filters which history entries the gate
+    baselines on — e.g. same-platform only, so a TPU run's seconds never
+    median into a CPU baseline — while the append still lands in the one
+    shared history."""
     _, history = load_history(path, key)
+    if match is not None:
+        history = [h for h in history if match(h)]
     problems = gate(current, history, specs)
     if not problems and append:
         append_history(path, current, key)

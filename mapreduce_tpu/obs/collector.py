@@ -277,6 +277,18 @@ class Collector:
     def _parsed_local(registry: Registry) -> Dict[Any, float]:
         return parse_prometheus(registry.render())
 
+    def metric_snapshots(self, exclude_self: bool = True,
+                         ) -> List[Dict[Any, float]]:
+        """Latest parsed metrics snapshot per pushing process — for
+        /statusz sections that aggregate families which are NOT
+        task-labelled (e.g. the checkpoint counters a separate trainer
+        process pushes).  ``exclude_self`` drops this process's own
+        pushed snapshot; it contributes through the live registry
+        instead (same dedup rule as :meth:`summary`)."""
+        snap = self._snapshot(spans=False)
+        return [st["metrics"] for proc, st in snap.items()
+                if not (exclude_self and proc == PROC_ID)]
+
     @staticmethod
     def _rollups(snapshots: List[Dict[Any, float]]) -> Dict[str, Dict[str,
                                                                       float]]:

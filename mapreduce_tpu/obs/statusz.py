@@ -17,10 +17,18 @@ import time
 from typing import Any, Dict, Optional
 
 from ..utils.constants import STATUS
-from .metrics import Registry, REGISTRY
+from .metrics import Registry, REGISTRY, parse_prometheus
 
-#: job-board collection suffixes that make up one task's database
+#: job-board collection suffixes that make up one task's database; the
+#: trainer-lease suffix is appended from its source of truth at scrape
+#: time (late import: coord pulls obs in at package load)
 _BOARD_SUFFIXES = ("task", "map_jobs", "red_jobs", "errors")
+
+
+def _board_suffixes():
+    from ..coord.lease import TrainerLease
+
+    return _BOARD_SUFFIXES + (TrainerLease.COLL,)
 
 
 def _status_name(code: Any) -> str:
@@ -34,9 +42,10 @@ def _dbnames(store) -> Dict[str, Dict[str, str]]:
     """Group board collections by database prefix: ``{db: {suffix: coll}}``
     (collections are named ``<db>.<suffix>``, coord/connection.ns)."""
     dbs: Dict[str, Dict[str, str]] = {}
+    suffixes = _board_suffixes()
     for coll in store.collections():
         db, sep, suffix = coll.rpartition(".")
-        if sep and suffix in _BOARD_SUFFIXES:
+        if sep and suffix in suffixes:
             dbs.setdefault(db, {})[suffix] = coll
     return dbs
 
@@ -80,6 +89,59 @@ def _workers(store, colls, now: float) -> Dict[str, Dict[str, Any]]:
     return workers
 
 
+def _trainer_lease(store, coll: Optional[str], now: float,
+                   ) -> Optional[Dict[str, Any]]:
+    """The training plane's lease doc (coord/lease.py singleton), with
+    the same timestamp-comparison liveness the worker view uses."""
+    from ..coord.lease import TrainerLease
+
+    if coll is None:
+        return None
+    doc = store.find_one(coll, {"_id": TrainerLease.SINGLETON_ID})
+    if doc is None:
+        return None
+    expires = doc.get("lease_expires") or 0.0
+    return {"holder": doc.get("holder"),
+            "generation": doc.get("generation", 0),
+            "lease_expires_in": round(expires - now, 3),
+            "held": bool(doc.get("holder")) and expires > now}
+
+
+def checkpoint_snapshot(registry: Registry = REGISTRY,
+                        collector=None) -> Dict[str, Any]:
+    """Checkpoint/lease counters (mrtpu_ckpt_* / mrtpu_trainer_*) for
+    the /statusz training section — summed over THIS process and every
+    process that pushed telemetry to the hosted *collector*, so a
+    docserver scrape sees a separate trainer process's saves/restores/
+    corruptions/fences (the `cli train` against `cli server` deployment
+    shape, where the counters live only in the trainer).  Gauges (last
+    saved step, recovery seconds) take the max across processes."""
+    snaps = [parse_prometheus(registry.render())]
+    if collector is not None:
+        snaps += collector.metric_snapshots()
+
+    def _agg(name, combine, **labels):
+        vals = [v for parsed in snaps for (n, lk), v in parsed.items()
+                if n == name and all(dict(lk).get(k) == w
+                                     for k, w in labels.items())]
+        return combine(vals) if vals else 0.0
+
+    snap = {
+        "saves": _agg("mrtpu_ckpt_saves_total", sum),
+        "restores_ok": _agg("mrtpu_ckpt_restores_total", sum,
+                            outcome="ok"),
+        "restores_corrupt": _agg("mrtpu_ckpt_restores_total", sum,
+                                 outcome="corrupt"),
+        "corrupt_shards": _agg("mrtpu_ckpt_corrupt_shards_total", sum),
+        "fallbacks": _agg("mrtpu_ckpt_fallbacks_total", sum),
+        "gc": _agg("mrtpu_ckpt_gc_total", sum),
+        "last_saved_step": _agg("mrtpu_ckpt_last_step", max, op="save"),
+        "lease_fences": _agg("mrtpu_trainer_lease_fences_total", sum),
+        "recovery_s": _agg("mrtpu_trainer_recovery_seconds", max),
+    }
+    return snap if any(snap.values()) else {}
+
+
 def cluster_status(store, now: Optional[float] = None,
                    collector=None) -> Dict[str, Any]:
     """The /statusz document: one entry per task database on the board,
@@ -89,6 +151,7 @@ def cluster_status(store, now: Optional[float] = None,
     identity, and — when the serving process hosts a telemetry
     *collector* (obs/collector) — the cluster's per-task roll-ups and
     per-process push health."""
+    from ..coord.lease import TrainerLease  # late: coord pulls obs
     from .buildinfo import build_info
     from .profile import device_snapshot  # late: profile pulls trace
 
@@ -96,6 +159,9 @@ def cluster_status(store, now: Optional[float] = None,
     out: Dict[str, Any] = {"now": now, "tasks": {},
                            "device": device_snapshot(),
                            "build": build_info()}
+    ckpt = checkpoint_snapshot(collector=collector)
+    if ckpt:
+        out["checkpoint"] = ckpt
     if collector is not None:
         out["telemetry"] = collector.summary()
     for db, colls in sorted(_dbnames(store).items()):
@@ -117,6 +183,9 @@ def cluster_status(store, now: Optional[float] = None,
             "errors": (store.count(colls["errors"])
                        if "errors" in colls else 0),
         }
+        trainer = _trainer_lease(store, colls.get(TrainerLease.COLL), now)
+        if trainer is not None:
+            entry["trainer"] = trainer
         out["tasks"][db] = entry
     return out
 

@@ -8,3 +8,5 @@ star ("replace polled shared state with compiled collectives").
 
 from .mesh import make_mesh, data_axis_size  # noqa: F401
 from .shuffle import partition_exchange, Exchanged  # noqa: F401
+from .partition import (  # noqa: F401
+    UnmatchedLeafError, match_partition_rules, shard_tree)

@@ -1,0 +1,115 @@
+"""Regex partition rules: one declarative table maps leaf paths to
+``PartitionSpec``s across params AND optimizer state.
+
+The trainers used to hand-thread a per-model ``param_spec(name)``
+function and rely on ``jit(opt.init)`` inheriting placements for the
+optimizer moments — two different mechanisms for one layout decision,
+and nothing that could name an optax leaf like ``1/0/trace/w0``.  This
+module is the `match_partition_rules` pattern (SNIPPETS.md [3]) applied
+uniformly to ANY pytree:
+
+  * every leaf gets a ``/``-joined path name built from its pytree keys
+    (dict keys, namedtuple fields, sequence indices), so a parameter and
+    its momentum mirror (``w0`` and ``1/0/trace/w0``) match the SAME
+    trailing-name rule;
+  * scalar and single-element leaves pass through replicated (``P()``)
+    without consulting the rules — optimizer step counters must never be
+    sharded by an over-eager regex;
+  * an unmatched non-scalar leaf is a LOUD :class:`UnmatchedLeafError`
+    naming every offender — a silently replicated weight matrix is a
+    memory-blowup-in-waiting on a real mesh, not a default.
+
+These rule tables are also what the sharded checkpoint layer
+(models/checkpoint.py) resolves restore placements from: the same regex
+table lays state out on WHATEVER mesh the restoring process built,
+which is what makes reshard-on-restore a non-event.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+#: a rule table: ``(regex, PartitionSpec)`` pairs, first match wins
+#: (``re.search`` semantics — anchor with ``$`` to match trailing leaf
+#: names so the table covers optimizer mirrors for free).
+Rules = Sequence[Tuple[str, P]]
+
+
+class UnmatchedLeafError(ValueError):
+    """A non-scalar leaf matched no partition rule.  Loud by design:
+    falling back to replicated would silently change the memory story
+    of every mesh the state lands on."""
+
+
+def _key_name(entry: Any) -> str:
+    """One pytree path entry -> its string form (DictKey / GetAttrKey /
+    SequenceKey / FlattenedIndexKey all carry exactly one of these)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def leaf_path(path: Tuple[Any, ...]) -> str:
+    """``/``-joined path name for a flattened pytree leaf."""
+    return "/".join(_key_name(e) for e in path)
+
+
+def flatten_with_names(tree: Any) -> Tuple[List[Tuple[str, Any]], Any]:
+    """Flatten *tree* to ``([(path_name, leaf), ...], treedef)`` in
+    canonical (tree_flatten) leaf order."""
+    flat, treedef = tree_flatten_with_path(tree)
+    return [(leaf_path(path), leaf) for path, leaf in flat], treedef
+
+
+def resolve_spec(rules: Rules, name: str, leaf: Any) -> P:
+    """The spec for ONE named leaf: scalar passthrough, then first
+    matching rule, else :class:`UnmatchedLeafError`."""
+    shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+    if len(shape) == 0 or int(np.prod(shape)) == 1:
+        return P()  # scalars/singletons (optax counts) always replicate
+    for rx, ps in rules:
+        if re.search(rx, name) is not None:
+            return ps
+    raise UnmatchedLeafError(
+        f"no partition rule matches leaf {name!r} (shape {shape}); "
+        "add a rule (or an explicit catch-all) — silent replication "
+        "is not a default")
+
+
+def match_partition_rules(rules: Rules, tree: Any) -> Any:
+    """A *tree*-shaped pytree of ``PartitionSpec``s resolved from
+    *rules* (the SNIPPETS.md [3] contract).  Raises
+    :class:`UnmatchedLeafError` naming EVERY unmatched leaf at once."""
+    named, treedef = flatten_with_names(tree)
+    specs: List[P] = []
+    unmatched: List[str] = []
+    for name, leaf in named:
+        try:
+            specs.append(resolve_spec(rules, name, leaf))
+        except UnmatchedLeafError:
+            unmatched.append(name)
+            specs.append(P())
+    if unmatched:
+        raise UnmatchedLeafError(
+            "no partition rule matches: " + ", ".join(unmatched))
+    return tree_unflatten(treedef, specs)
+
+
+def shard_tree(tree: Any, rules: Rules, mesh: Mesh) -> Any:
+    """``device_put`` every leaf of *tree* onto *mesh* with its
+    rule-resolved ``NamedSharding`` — the one placement path for params
+    and optimizer state alike (init AND reshard-on-restore)."""
+    named, treedef = flatten_with_names(tree)
+    placed = [
+        jax.device_put(leaf,
+                       NamedSharding(mesh, resolve_spec(rules, name, leaf)))
+        for name, leaf in named]
+    return tree_unflatten(treedef, placed)

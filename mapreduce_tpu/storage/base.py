@@ -103,6 +103,19 @@ class Storage:
         b.append(content)
         b.build(name)
 
+    # binary blob plane: checkpoint shards (models/checkpoint.py) are
+    # npy bytes, not utf-8 text, so every backend carries a bytes path
+    # beside the str one.  Same atomic-publish contract; backends count
+    # their own storage_io (these bypass the str wrappers above).
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} has no binary blob support")
+
+    def read_bytes(self, name: str) -> bytes:
+        raise NotImplementedError(
+            f"{type(self).__name__} has no binary blob support")
+
     def list(self, pattern: Optional[str] = None) -> List[str]:
         """Names matching regex *pattern* (reference matches Lua patterns
         against GridFS filenames, e.g. ``^path/.*P.*M.*$`` server.lua:291).

@@ -45,6 +45,7 @@ import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..obs import metrics as _metrics
+from ..obs.metrics import storage_io, storage_op
 from ..utils.httpclient import (
     DEFAULT_POOL_SIZE, KeepAlivePool, RetryPolicy, blob_policy, check_auth,
     default_auth_token)
@@ -300,7 +301,23 @@ class HttpStorage(Storage):
         return "/blobs/" + urllib.parse.quote(name, safe="")
 
     def _publish(self, name: str, content: str) -> None:
-        raw = content.encode()
+        # str plane: the base FileBuilder counts storage_io/storage_op
+        self._put_bytes(name, content.encode())
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        """Binary PUT (checkpoint shards ride this); counts its own
+        ``storage_io{scheme=http}`` like the other backends' bytes
+        planes — the str wrappers bypass this method, so nothing
+        double-counts."""
+        self._put_bytes(name, data)
+        storage_io(self.scheme, "write", len(data))
+        storage_op(self.scheme, "publish")
+
+    def _put_bytes(self, name: str, data: bytes) -> None:
+        """Transport: gzip-negotiated PUT; the server's bytes-through
+        handler stores the body verbatim, so the str and bytes planes
+        interoperate on utf-8 blobs."""
+        raw = data
         data, headers = raw, None
         if (self._compress and self._server_gzip
                 and len(raw) >= GZIP_MIN_BYTES):
@@ -328,11 +345,21 @@ class HttpStorage(Storage):
         return None
 
     def _read(self, name: str) -> str:
+        # str plane: the base read() wrapper counts storage_io
+        return self._get_bytes(name).decode()
+
+    def read_bytes(self, name: str) -> bytes:
+        data = self._get_bytes(name)
+        storage_io(self.scheme, "read", len(data))
+        storage_op(self.scheme, "read")
+        return data
+
+    def _get_bytes(self, name: str) -> bytes:
         status, body = self._request("GET", self._blob_path(name),
                                      headers=self._accept_gzip())
         if status != 200:
             raise FileNotFoundError(f"{name!r}: HTTP {status}")
-        return body.decode()
+        return body
 
     #: Range-GET slice size for open_lines.  Memory held client-side is
     #: O(LINES_CHUNK + longest line), never the whole blob — the role of

@@ -7,19 +7,25 @@ share blobs the way reference processes share mongod's GridFS.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Union
 
+from ..obs.metrics import storage_io, storage_op
 from .base import Storage
 
 
 class MemoryStorage(Storage):
+    """Blobs are str (the record planes) or bytes (checkpoint shards);
+    each API decodes/encodes at the boundary so either writer's blob is
+    readable through either reader (utf-8 by contract, like the disk
+    backend)."""
+
     scheme = "mem"
 
     _registry: Dict[str, "MemoryStorage"] = {}
     _registry_lock = threading.Lock()
 
     def __init__(self) -> None:
-        self._blobs: Dict[str, str] = {}
+        self._blobs: Dict[str, Union[str, bytes]] = {}
         self._lock = threading.RLock()
 
     @classmethod
@@ -39,15 +45,32 @@ class MemoryStorage(Storage):
             self._blobs[name] = content
 
     def _open_lines(self, name: str) -> Iterator[str]:
-        with self._lock:
-            content = self._blobs[name]
-        for line in content.splitlines():
+        for line in self._read(name).splitlines():
             if line:
                 yield line
 
     def _read(self, name: str) -> str:
         with self._lock:
-            return self._blobs[name]
+            content = self._blobs[name]
+        return content.decode("utf-8") if isinstance(content, bytes) \
+            else content
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        with self._lock:
+            self._blobs[name] = data
+        storage_io(self.scheme, "write", len(data))
+        storage_op(self.scheme, "publish")
+
+    def read_bytes(self, name: str) -> bytes:
+        with self._lock:
+            if name not in self._blobs:  # FileNotFoundError like the
+                raise FileNotFoundError(name)  # disk/http backends
+            content = self._blobs[name]
+        data = content.encode("utf-8") if isinstance(content, str) \
+            else content
+        storage_io(self.scheme, "read", len(data))
+        storage_op(self.scheme, "read")
+        return data
 
     def _all_names(self) -> List[str]:
         with self._lock:

@@ -1,0 +1,433 @@
+"""Sharded checkpoint layer: manifest-last atomicity, digest-verified
+corruption fallback, retention, typed validation, reshard-on-restore,
+and the binary blob plane the shards ride (mem / shared / http)."""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from mapreduce_tpu.models import checkpoint as ckpt
+from mapreduce_tpu.models.checkpoint import (
+    CheckpointCorruptError, CheckpointError, CheckpointManager)
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.parallel.partition import (
+    flatten_with_names, match_partition_rules)
+from mapreduce_tpu.storage.localdir import LocalDirStorage
+from mapreduce_tpu.storage.memory import MemoryStorage
+
+RULES = ((r"w\d*$", P(None, "model")), (r"b\d*$", P("model")),
+         (r".", P()))
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w0": rng.normal(size=(8, 4)).astype(np.float32),
+            "b0": rng.normal(size=(8,)).astype(np.float32),
+            "count": np.int32(7)}
+
+
+def _assert_tree_equal(a, b):
+    for (na, la), (nb, lb) in zip(*(flatten_with_names(t)[0]
+                                    for t in (a, b))):
+        assert na == nb
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# -- blob plane bytes support ------------------------------------------------
+
+
+def test_memory_storage_bytes_roundtrip():
+    st = MemoryStorage()
+    st.write_bytes("bin", b"\x00\xffraw")
+    assert st.read_bytes("bin") == b"\x00\xffraw"
+    # str and bytes planes interop on utf-8 blobs
+    st.write("txt", "hello")
+    assert st.read_bytes("txt") == b"hello"
+    st.write_bytes("txt2", "hi".encode())
+    assert st.read("txt2") == "hi"
+    with pytest.raises(FileNotFoundError):
+        st.read_bytes("nope")
+
+
+def test_localdir_storage_bytes_roundtrip(tmp_path):
+    st = LocalDirStorage(str(tmp_path))
+    st.write_bytes("bin", b"\x00\x01\x02")
+    assert st.read_bytes("bin") == b"\x00\x01\x02"
+
+
+# -- save / restore core -----------------------------------------------------
+
+
+def test_save_restore_roundtrip_and_manifest_shape():
+    st = MemoryStorage()
+    tree = _tree()
+    name = ckpt.save(st, 3, tree, rules=RULES, meta={"k": "v"})
+    manifest = json.loads(st.read(name))
+    assert manifest["step"] == 3 and manifest["meta"] == {"k": "v"}
+    ent = manifest["leaves"]["w0"]
+    assert ent["shape"] == [8, 4] and ent["dtype"] == "float32"
+    assert ent["spec"] == [None, "model"]
+    for sh in ent["shards"]:
+        assert sh["sha256"] and sh["nbytes"] > 0
+    # scalar leaves pass the rules untouched and round-trip as 0-d
+    assert manifest["leaves"]["count"]["shape"] == []
+    got, man = ckpt.restore_latest(st, tree)
+    _assert_tree_equal(got, tree)
+    assert np.shape(got["count"]) == ()
+
+
+def test_manifest_is_the_atomic_commit_point():
+    """A kill between shard write and manifest write must leave the
+    PREVIOUS checkpoint authoritative: shards without a manifest are
+    invisible to list_steps and restore."""
+    st = MemoryStorage()
+    t1, t2 = _tree(1), _tree(2)
+    ckpt.save(st, 1, t1)
+
+    class _Killed(RuntimeError):
+        pass
+
+    class KillBeforeManifest(MemoryStorage):
+        def __init__(self, inner):
+            super().__init__()
+            self._blobs = inner._blobs  # share the blob dict
+            self._lock = inner._lock
+
+        def write(self, name, content):  # the manifest publish path
+            raise _Killed(name)
+
+    with pytest.raises(_Killed):
+        ckpt.save(KillBeforeManifest(st), 2, t2)
+    # step-2 shards exist...
+    assert st.list(r"ckpt-00000002/")
+    # ...but the checkpoint does not
+    assert ckpt.list_steps(st) == [1]
+    got, man = ckpt.restore_latest(st, t1)
+    assert man["step"] == 1
+    _assert_tree_equal(got, t1)
+
+
+def test_corrupt_shard_falls_back_to_previous_complete(tmp_path):
+    """Truncated/garbled shard -> digest check fails that checkpoint ->
+    restore falls back to the previous complete one, counted in the
+    mrtpu_ckpt_* family."""
+    st = LocalDirStorage(str(tmp_path))
+    t1, t2 = _tree(1), _tree(2)
+    ckpt.save(st, 1, t1)
+    ckpt.save(st, 2, t2)
+    shard = st.list(r"ckpt-00000002/.*w0")[0]
+    st.write_bytes(shard, st.read_bytes(shard)[:-7])  # truncate
+    before = (REGISTRY.sum("mrtpu_ckpt_fallbacks_total"),
+              REGISTRY.sum("mrtpu_ckpt_corrupt_shards_total"))
+    got, man = ckpt.restore_latest(st, t1)
+    assert man["step"] == 1
+    _assert_tree_equal(got, t1)
+    assert REGISTRY.sum("mrtpu_ckpt_fallbacks_total") == before[0] + 1
+    assert REGISTRY.sum("mrtpu_ckpt_corrupt_shards_total") == \
+        before[1] + 1
+    # a direct restore of the bad step is the typed corruption error
+    with pytest.raises(CheckpointCorruptError, match="digest"):
+        ckpt.restore(st, t2, 2)
+
+
+def test_unparseable_manifest_falls_back():
+    st = MemoryStorage()
+    ckpt.save(st, 1, _tree(1))
+    ckpt.save(st, 2, _tree(2))
+    st.write(ckpt.manifest_name("", 2), "{not json")
+    got, man = ckpt.restore_latest(st, _tree(1))
+    assert man["step"] == 1
+
+
+def test_garbled_parseable_manifest_is_corrupt_not_keyerror():
+    """A manifest that parses as JSON but is structurally wrong —
+    leaves entry missing shape/shards, wrong internal step, non-dict
+    meta, shard index outside the declared shape — is the typed
+    CheckpointCorruptError (fallback-eligible), never a raw
+    KeyError/TypeError escaping from three frames down."""
+    st = MemoryStorage()
+    ckpt.save(st, 1, _tree(1))
+    ckpt.save(st, 2, _tree(2))
+    mname = ckpt.manifest_name("", 2)
+    good = json.loads(st.read(mname))
+
+    bad_docs = [
+        {"format": ckpt.FORMAT, "step": 2, "meta": {},
+         "leaves": {"w0": {"bad": 1}}},     # entry missing everything
+        {**good, "step": 7},                # internal step != path step
+        {**good, "meta": []},               # meta not a dict
+    ]
+    shifted = json.loads(json.dumps(good))  # deep copy
+    shifted["leaves"]["w0"]["shards"][0]["index"] = [[0, 99], [0, 4]]
+    bad_docs.append(shifted)                # index outside shape
+    for doc in bad_docs:
+        st.write(mname, json.dumps(doc))
+        with pytest.raises(CheckpointCorruptError):
+            ckpt.load_manifest(st, "", 2)
+        got, man = ckpt.restore_latest(st, _tree(1))
+        assert man["step"] == 1             # fell back, didn't crash
+
+
+def test_all_checkpoints_bad_is_loud():
+    st = MemoryStorage()
+    ckpt.save(st, 1, _tree(1))
+    st.write(ckpt.manifest_name("", 1), "garbage")
+    with pytest.raises(CheckpointError, match="no complete checkpoint"):
+        ckpt.restore_latest(st, _tree(1))
+    # an empty prefix is None (first run), not an error
+    assert ckpt.restore_latest(MemoryStorage(), _tree(1)) is None
+
+
+def test_restore_validation_is_typed_not_keyerror():
+    """The old npz loader trusted the file blindly (a missing key
+    surfaced as a KeyError deep in fit); every mismatch is now a typed
+    CheckpointError naming the offender, and a config mismatch does
+    NOT fall back to an older checkpoint."""
+    st = MemoryStorage()
+    tree = _tree()
+    ckpt.save(st, 1, tree)
+    missing = {k: v for k, v in tree.items() if k != "b0"}
+    with pytest.raises(CheckpointError, match="b0"):
+        ckpt.restore_latest(st, missing)
+    extra = dict(tree, rogue=np.zeros((2,), np.float32))
+    with pytest.raises(CheckpointError, match="rogue"):
+        ckpt.restore_latest(st, extra)
+    badshape = dict(tree, w0=np.zeros((9, 4), np.float32))
+    with pytest.raises(CheckpointError, match="w0"):
+        ckpt.restore_latest(st, badshape)
+    baddtype = dict(tree, w0=tree["w0"].astype(np.float64))
+    with pytest.raises(CheckpointError, match="w0"):
+        ckpt.restore_latest(st, baddtype)
+
+
+# -- retention ---------------------------------------------------------------
+
+
+def test_retention_keeps_newest_n_plus_best():
+    st = MemoryStorage()
+    mgr = CheckpointManager(st, keep_n=2)
+    for step in range(1, 6):
+        mgr.save(step, _tree(step))
+        if step == 2:
+            mgr.mark_best(step)
+    assert mgr.steps() == [2, 4, 5]  # newest 2 + the marked best
+    assert mgr.best_step() == 2
+    # the dropped checkpoints' shards are gone too, not just manifests
+    assert not st.list(r"ckpt-00000001/")
+    assert not st.list(r"ckpt-00000003/")
+    got, man = mgr.restore_latest(_tree())
+    assert man["step"] == 5
+
+
+def test_gc_removes_manifest_first(tmp_path):
+    """Retention deletes the manifest before the shards, so a crash
+    mid-gc can only leave an INVISIBLE half-checkpoint, never a
+    'complete' one with missing shards."""
+    st = LocalDirStorage(str(tmp_path))
+
+    class KillAfterManifestRemove(LocalDirStorage):
+        def remove_many(self, names):
+            raise RuntimeError("crashed mid-gc")
+
+    mgr = CheckpointManager(KillAfterManifestRemove(str(tmp_path)),
+                            keep_n=1)
+    mgr.save(1, _tree(1), gc=False)
+    mgr.save(2, _tree(2), gc=False)
+    with pytest.raises(RuntimeError, match="mid-gc"):
+        mgr.gc()
+    # step 1's manifest is gone -> the checkpoint does not exist, even
+    # though its shard blobs survived the crash
+    assert ckpt.list_steps(st) == [2]
+    assert st.list(r"ckpt-00000001/")
+
+
+# -- reshard-on-restore ------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_model,n_data", [(1, 4), (4, 2)])
+def test_reshard_on_restore_value_identical(n_model, n_data):
+    """A checkpoint saved under one mesh restores value-identically on
+    a different device count (8 -> 4) and on a different 2-D layout,
+    with placements resolved by the regex rules on the TARGET mesh —
+    for params and a momentum-mirror leaf alike."""
+    import optax
+
+    mesh_a = make_mesh(n_model=2)  # 2 x 4 over all 8 devices
+    rng = np.random.default_rng(0)
+    params = {"w0": rng.normal(size=(8, 4)).astype(np.float32),
+              "b0": rng.normal(size=(8,)).astype(np.float32)}
+    opt = optax.sgd(0.1, momentum=0.9)
+    tree = {"params": params, "opt": opt.init(params)}
+    from mapreduce_tpu.parallel.partition import shard_tree
+
+    placed = shard_tree(tree, RULES, mesh_a)
+    st = MemoryStorage()
+    ckpt.save(st, 5, placed, rules=RULES)
+
+    mesh_b = make_mesh(n_model=n_model, n_data=n_data)
+    got, man = ckpt.restore_latest(st, tree, mesh=mesh_b, rules=RULES)
+    _assert_tree_equal(got, tree)
+    assert got["params"]["w0"].sharding.mesh.shape == \
+        {"model": n_model, "data": n_data}
+    assert got["params"]["w0"].sharding.spec == P(None, "model")
+    # the momentum mirror reshards by the same trailing-name rule
+    trace = jax.tree.leaves(got["opt"])
+    assert all(x.sharding.mesh.shape["model"] == n_model for x in trace)
+
+
+def test_sharded_save_dedupes_replicated_copies():
+    """A fully-replicated leaf on 8 devices stores ONE shard, not 8."""
+    mesh = make_mesh()
+    arr = jax.device_put(
+        np.arange(16, dtype=np.float32),
+        jax.sharding.NamedSharding(mesh, P()))
+    st = MemoryStorage()
+    ckpt.save(st, 1, {"r": arr})
+    shards = st.list(r"ckpt-00000001/r\.")
+    assert len(shards) == 1
+    man = json.loads(st.read(ckpt.manifest_name("", 1)))
+    assert len(man["leaves"]["r"]["shards"]) == 1
+
+
+# -- the http blob plane end-to-end ------------------------------------------
+
+
+def test_checkpoint_through_http_blob_plane(tmp_path):
+    """Shards and manifest ride the BlobServer/HttpStorage plane (binary
+    PUT/GET with gzip negotiation in play) and restore digest-clean."""
+    from mapreduce_tpu.storage.httpstore import BlobServer, HttpStorage
+
+    blob = BlobServer(str(tmp_path / "blobs")).start_background()
+    try:
+        st = HttpStorage(blob.address)
+        tree = _tree()
+        mgr = CheckpointManager(st, prefix="train/", keep_n=2)
+        mgr.save(1, tree)
+        mgr.save(2, _tree(2))
+        got, man = mgr.restore_latest(tree)
+        assert man["step"] == 2
+        _assert_tree_equal(got, _tree(2))
+        assert mgr.steps() == [1, 2]
+        # a mesh-sharded save: the multi-leaf PUT fan-out and the
+        # per-shard GET fan-out (both http-gated thread pools) must
+        # stay digest-clean and value-identical
+        from mapreduce_tpu.parallel.partition import shard_tree
+        mesh = make_mesh(n_model=4, n_data=2)
+        mgr.save(3, shard_tree(tree, RULES, mesh), rules=RULES)
+        man3 = json.loads(st.read(ckpt.manifest_name("train/", 3)))
+        assert len(man3["leaves"]["w0"]["shards"]) == 4  # model=4 split
+        got3, m3 = mgr.restore_latest(tree)
+        assert m3["step"] == 3
+        _assert_tree_equal(got3, tree)
+        st.close()
+    finally:
+        blob.shutdown()
+
+
+# -- observability: /statusz + status CLI surfaces ---------------------------
+
+
+def test_checkpoint_counters_visible_in_statusz_and_status_cli():
+    """The mrtpu_ckpt_* family renders on /metrics (registry), rolls up
+    into the /statusz ``checkpoint`` section, and the status CLI prints
+    it — plus the per-db trainer-lease doc with liveness."""
+    from mapreduce_tpu.cli import render_status
+    from mapreduce_tpu.coord import Connection, TrainerLease
+    from mapreduce_tpu.coord.docstore import MemoryDocStore, now
+    from mapreduce_tpu.obs.statusz import (
+        checkpoint_snapshot, cluster_status)
+
+    st = MemoryStorage()
+    ckpt.save(st, 4, _tree())
+    ckpt.restore_latest(st, _tree())
+    snap = checkpoint_snapshot()
+    assert snap["saves"] >= 1 and snap["restores_ok"] >= 1
+    assert snap["last_saved_step"] == 4
+    assert "mrtpu_ckpt_saves_total" in REGISTRY.render()
+
+    name = f"statusz-{np.random.default_rng().integers(1 << 30)}"
+    cnn = Connection(f"mem://{name}", "traindb")
+    lease = TrainerLease(cnn, holder="T", lease=30.0)
+    assert lease.try_acquire()
+    doc = cluster_status(MemoryDocStore.named(name), now=now())
+    t = doc["tasks"]["traindb"]["trainer"]
+    assert t["holder"] == "T" and t["held"] and t["generation"] == 1
+    assert doc["checkpoint"]["saves"] >= 1
+    text = render_status(doc)
+    assert "checkpoints:" in text and "trainer lease: T" in text
+
+
+def test_gc_reclaims_orphaned_shards_below_newest():
+    """Shards whose commit aborted (fenced at precommit / killed before
+    the manifest) must not leak forever: gc() reclaims manifestless
+    shard dirs BELOW the newest committed step, and leaves manifestless
+    dirs above it alone — those may be a commit in flight."""
+    st = MemoryStorage()
+    mgr = CheckpointManager(st, keep_n=5)
+    mgr.save(1, _tree(1))
+    mgr.save(3, _tree(3))
+    # an aborted commit at step 2 (below newest) and one in flight at 9
+    st.write_bytes("ckpt-00000002/w0.0.npy", b"orphan")
+    st.write_bytes("ckpt-00000009/w0.0.npy", b"inflight")
+    mgr.gc()
+    assert not st.list(r"ckpt-00000002/")          # reclaimed
+    assert st.list(r"ckpt-00000009/")              # left alone
+    assert ckpt.list_steps(st) == [1, 3]           # checkpoints intact
+    got, man = ckpt.restore_latest(st, _tree(3))
+    assert man["step"] == 3
+
+
+def test_checkpoint_section_aggregates_pushed_telemetry():
+    """The /statusz checkpoint section must see a SEPARATE trainer
+    process: in the `cli train` vs `cli server` split deployment the
+    mrtpu_ckpt_* counters exist only in the trainer, which pushes them
+    to the docserver's collector — counters sum with the serving
+    process's registry, gauges take the max."""
+    from mapreduce_tpu.obs.collector import Collector
+    from mapreduce_tpu.obs.statusz import checkpoint_snapshot
+
+    local = checkpoint_snapshot()  # this process's registry alone
+    coll = Collector()
+    coll.push({"proc": "trainer-proc", "role": "trainer:t1",
+               "metrics": (
+                   "# HELP mrtpu_ckpt_saves_total c\n"
+                   "# TYPE mrtpu_ckpt_saves_total counter\n"
+                   "mrtpu_ckpt_saves_total 7\n"
+                   "# HELP mrtpu_ckpt_last_step g\n"
+                   "# TYPE mrtpu_ckpt_last_step gauge\n"
+                   'mrtpu_ckpt_last_step{op="save"} 41000\n'
+                   "# HELP mrtpu_trainer_lease_fences_total c\n"
+                   "# TYPE mrtpu_trainer_lease_fences_total counter\n"
+                   "mrtpu_trainer_lease_fences_total 2\n")})
+    snap = checkpoint_snapshot(collector=coll)
+    assert snap["saves"] == local.get("saves", 0) + 7
+    assert snap["last_saved_step"] == max(
+        local.get("last_saved_step", 0), 41000)
+    assert snap["lease_fences"] == local.get("lease_fences", 0) + 2
+
+
+# -- rules sanity over a real optax chain ------------------------------------
+
+
+def test_match_partition_rules_uniform_over_state():
+    import optax
+
+    params = {"w0": np.zeros((4, 4), np.float32),
+              "b0": np.zeros((4,), np.float32)}
+    opt = optax.chain(optax.add_decayed_weights(1e-4),
+                      optax.sgd(0.1, momentum=0.9))
+    specs = match_partition_rules(
+        RULES, {"params": params, "opt": opt.init(params)})
+    named, _ = flatten_with_names(specs)
+    by_name = dict(named)
+    assert by_name["params/w0"] == P(None, "model")
+    # the momentum mirror of w0 resolves through the SAME rule
+    trace_w0 = [s for n, s in named if n.endswith("/w0")
+                and n.startswith("opt/")]
+    assert trace_w0 == [P(None, "model")]

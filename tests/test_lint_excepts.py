@@ -171,6 +171,15 @@ _MONOTONIC_ONLY_MODULES = {
     # also pins down
     os.path.join("mapreduce_tpu", "obs", "collector.py"),
     os.path.join("mapreduce_tpu", "obs", "analysis.py"),
+    # the elastic training plane: fit()'s recovery gauge and the
+    # checkpoint layer feed gated bench numbers (trainer_recovery_s)
+    # and step-recovery telemetry — duration math only, so the whole
+    # family is monotonic-only (persisted lease timestamps are minted
+    # through coord/docstore.now inside coord/lease.py, which reads
+    # time.monotonic/time.sleep and nothing else besides)
+    os.path.join("mapreduce_tpu", "models", "trainer.py"),
+    os.path.join("mapreduce_tpu", "models", "checkpoint.py"),
+    os.path.join("mapreduce_tpu", "coord", "lease.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions

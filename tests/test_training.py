@@ -13,8 +13,11 @@ from jax.sharding import PartitionSpec as P
 from mapreduce_tpu import spec
 from mapreduce_tpu.models import (
     DistributedTrainer, MLPConfig, TrainConfig, make_digits)
-from mapreduce_tpu.models.trainer import (
-    load_checkpoint, param_spec, save_checkpoint)
+from mapreduce_tpu.models.trainer import TRAINER_PARTITION_RULES
+from mapreduce_tpu.models.checkpoint import CheckpointManager
+from mapreduce_tpu.parallel.partition import (
+    UnmatchedLeafError, match_partition_rules)
+from mapreduce_tpu.storage.localdir import LocalDirStorage
 from mapreduce_tpu.parallel import make_mesh
 
 
@@ -51,9 +54,19 @@ def test_trainer_converges_dp_tp(tmp_path):
     # params carry real TP shardings on the mesh
     w0 = out["params"]["w0"]
     assert w0.sharding.spec == P(None, "model")
-    # checkpoints were written and round-trip
-    params, epoch = load_checkpoint(str(tmp_path / "ckpt" / "last"))
-    assert params["w0"].shape == (256, 128) and epoch >= 1
+    # sharded checkpoints were committed under the retention policy
+    # (newest keep_n + best) and round-trip through the manager
+    mgr = CheckpointManager(LocalDirStorage(str(tmp_path / "ckpt")))
+    steps = mgr.steps()
+    assert steps and steps[-1] == out["epochs_run"]
+    assert mgr.best_step() == out["best_epoch"]
+    state, manifest = mgr.restore_latest(
+        {"params": out["params"], "opt": out["opt_state"]},
+        mesh=mesh, rules=TRAINER_PARTITION_RULES)
+    assert manifest["step"] == steps[-1]
+    np.testing.assert_array_equal(np.asarray(state["params"]["w0"]),
+                                  np.asarray(out["params"]["w0"]))
+    assert state["params"]["w0"].sharding.spec == P(None, "model")
 
 
 def test_trainer_smoothing_runs():
@@ -115,19 +128,123 @@ def test_train_epoch_donates_stacked_batches():
 
 
 def test_checkpoint_roundtrip(tmp_path):
+    from mapreduce_tpu.models import checkpoint as ckpt
+
+    store = LocalDirStorage(str(tmp_path))
     params = {"w0": np.ones((4, 3), np.float32),
               "b0": np.zeros((3,), np.float32)}
-    save_checkpoint(str(tmp_path / "c"), params, epoch=7)
-    loaded, epoch = load_checkpoint(str(tmp_path / "c"))
-    assert epoch == 7
+    ckpt.save(store, 7, params)
+    got = ckpt.restore_latest(store, params)
+    assert got is not None
+    loaded, manifest = got
+    assert manifest["step"] == 7
     np.testing.assert_array_equal(loaded["w0"], params["w0"])
 
 
-def test_param_spec_alternates():
-    assert param_spec("w0", None) == P(None, "model")
-    assert param_spec("w1", None) == P("model", None)
-    assert param_spec("b0", None) == P("model")
-    assert param_spec("b1", None) in (P(), P(None))  # both = replicated
+def test_partition_rules_alternate():
+    """The regex table reproduces the old hand-threaded param_spec
+    layout (even layers column-split, odd row-split) and applies the
+    SAME rule to optimizer mirrors; scalars pass through replicated
+    and an unmatched leaf errors loudly."""
+    shapes = {"w0": np.zeros((4, 4)), "w1": np.zeros((4, 4)),
+              "b0": np.zeros((4,)), "b1": np.zeros((4,))}
+    specs = match_partition_rules(TRAINER_PARTITION_RULES, shapes)
+    assert specs["w0"] == P(None, "model")
+    assert specs["w1"] == P("model", None)
+    assert specs["b0"] == P("model")
+    assert specs["b1"] in (P(), P(None))  # both = replicated
+
+    # optimizer mirrors resolve through the same trailing-name rules
+    import optax
+
+    opt = optax.sgd(0.1, momentum=0.9)
+    st = opt.init({k: jax.numpy.asarray(v) for k, v in shapes.items()})
+    opt_specs = jax.tree.leaves(
+        match_partition_rules(TRAINER_PARTITION_RULES, st))
+    flat = jax.tree.leaves(
+        match_partition_rules(TRAINER_PARTITION_RULES, shapes))
+    assert sorted(map(str, opt_specs)) == sorted(map(str, flat))
+
+    # scalar passthrough: no rule consulted, always replicated
+    assert match_partition_rules(
+        TRAINER_PARTITION_RULES, {"q": np.float32(3.0)})["q"] == P()
+
+    # unmatched non-scalar leaves fail LOUDLY, all named at once
+    with pytest.raises(UnmatchedLeafError, match="mystery"):
+        match_partition_rules(TRAINER_PARTITION_RULES,
+                              {"mystery": np.zeros((2, 2))})
+
+
+def test_init_state_moments_born_sharded():
+    """opt.init runs under jit with out_shardings from the rule table:
+    the momentum trace comes back carrying the SAME rule-resolved
+    shardings as its parameter mirrors (born sharded — at scale the
+    trace never fits replicated on one device, init included)."""
+    mesh = make_mesh(n_model=2)  # model=2, data=4
+    trainer = DistributedTrainer(mesh, MLPConfig(), TrainConfig())
+    params, opt_state = trainer.init_state()
+    from mapreduce_tpu.parallel.partition import flatten_with_names
+    named_p = dict(flatten_with_names({"params": params})[0])
+    named_o, _ = flatten_with_names({"opt": opt_state})
+    # every trace mirror .../trace/<name> shares <name>'s sharding
+    mirrors = [(n, leaf) for n, leaf in named_o if "/trace/" in n]
+    assert mirrors
+    for name, leaf in mirrors:
+        pname = "params/" + name.rsplit("/", 1)[1]
+        assert leaf.sharding == named_p[pname].sharding, name
+        assert np.asarray(leaf).max() == 0.0  # fresh trace is zeros
+
+
+def test_fit_resume_rejects_foreign_lineage(tmp_path):
+    """The manifest stamps the lineage-determining TrainConfig fields;
+    a resume under different values is a typed CheckpointError naming
+    the offenders — NOT a silent continuation of a foreign lineage —
+    while non-lineage knobs (retention) stay free to change."""
+    from mapreduce_tpu.models.checkpoint import CheckpointError
+
+    mesh = make_mesh()
+    cfg = TrainConfig(learning_rate=0.1, bunch_size=32, max_epochs=2,
+                      min_epochs=1, patience=5)
+    x_tr, y_tr, x_va, y_va = make_digits(n_train=160, n_val=40)
+    DistributedTrainer(mesh, MLPConfig(), cfg).fit(
+        x_tr, y_tr, x_va, y_va, checkpoint_dir=str(tmp_path / "c"))
+
+    import dataclasses
+    foreign = dataclasses.replace(cfg, seed=99, learning_rate=0.5)
+    with pytest.raises(CheckpointError) as ei:
+        DistributedTrainer(mesh, MLPConfig(), foreign).fit(
+            x_tr, y_tr, x_va, y_va, checkpoint_dir=str(tmp_path / "c"))
+    assert "seed" in str(ei.value) and "learning_rate" in str(ei.value)
+
+    # retention is not lineage: changing it resumes fine
+    relaxed = dataclasses.replace(cfg, keep_checkpoints=7, max_epochs=3)
+    out = DistributedTrainer(mesh, MLPConfig(), relaxed).fit(
+        x_tr, y_tr, x_va, y_va, checkpoint_dir=str(tmp_path / "c"))
+    assert out["restored"]
+
+
+def test_fit_resume_after_early_stop_trains_nothing(tmp_path):
+    """A run that already early-stopped must not advance when resumed:
+    restore re-evaluates the stopping criterion, so a preempt-and-resume
+    cycle returns the same final state as the uninterrupted run instead
+    of committing one extra epoch per restart."""
+    mesh = make_mesh()
+    # lr 0: no epoch after the first can improve the holdout, so the
+    # run deterministically stops at epoch 1 + patience
+    cfg = TrainConfig(learning_rate=0.0, bunch_size=32,
+                      max_epochs=10, min_epochs=1, patience=2)
+    x_tr, y_tr, x_va, y_va = make_digits(n_train=160, n_val=40)
+    first = DistributedTrainer(mesh, MLPConfig(), cfg).fit(
+        x_tr, y_tr, x_va, y_va, checkpoint_dir=str(tmp_path / "c"))
+    assert first["epochs_run"] == 3  # it DID early-stop (1 + patience)
+
+    again = DistributedTrainer(mesh, MLPConfig(), cfg).fit(
+        x_tr, y_tr, x_va, y_va, checkpoint_dir=str(tmp_path / "c"))
+    assert again["restored"] and again["epochs_run"] == 0
+    assert again["best_epoch"] == first["best_epoch"]
+    for k in first["params"]:
+        np.testing.assert_array_equal(np.asarray(first["params"][k]),
+                                      np.asarray(again["params"][k]))
 
 
 def test_train_digits_through_job_board():

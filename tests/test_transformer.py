@@ -310,6 +310,47 @@ def test_checkpoint_roundtrip_and_reshard(tmp_path):
         other.load(str(tmp_path / "ckpt"))
 
 
+def test_checkpoint_retention_and_corrupt_fallback(tmp_path):
+    """save() keeps only the newest *keep* checkpoints (the old npz
+    overwrote in place — the sharded layout must stay bounded too), and
+    load() falls back past a corrupt shard to the previous complete
+    checkpoint instead of aborting, counted in ``mrtpu_ckpt_*`` (the
+    restore policy of models/checkpoint.py)."""
+    import numpy as np
+
+    from mapreduce_tpu.models import checkpoint as ckpt
+    from mapreduce_tpu.obs.metrics import REGISTRY
+    from mapreduce_tpu.parallel import make_mesh
+    from mapreduce_tpu.storage.localdir import LocalDirStorage
+
+    cfg = TransformerConfig(vocab=64, embed=32, n_layers=2, n_heads=4,
+                            head_dim=8, ffn=64)
+    mesh = make_mesh(n_data=4, n_model=2)
+    tr = TransformerTrainer(mesh, cfg, learning_rate=1e-2)
+    params = tr.init_params()
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, size=(2, 33)).astype(np.int32)
+    d = tmp_path / "r"
+    saved = {}
+    for step in range(1, 5):
+        params, _ = tr.step(params, toks)
+        tr.save(str(d), params, step=step, keep=2)
+        saved[step] = {k: np.asarray(v) for k, v in params.items()}
+    st = LocalDirStorage(str(d))
+    assert ckpt.list_steps(st) == [3, 4]
+
+    # garble one shard of the newest checkpoint: load() must fall back
+    # to step 3 value-identically and count the event
+    shard = st.list(r"ckpt-00000004/.*\.npy")[0]
+    st.write_bytes(shard, b"\x00" * 8)
+    before = REGISTRY.sum("mrtpu_ckpt_fallbacks_total")
+    p2, step = tr.load(str(d))
+    assert step == 3
+    for k in p2:
+        np.testing.assert_array_equal(np.asarray(p2[k]), saved[3][k])
+    assert REGISTRY.sum("mrtpu_ckpt_fallbacks_total") == before + 1
+
+
 def test_adamw_optimizer_path_and_state_checkpoint(tmp_path):
     """The optax path: adamw trains under the tp x sp mesh, and
     save/load_state restores BOTH params and moments — the resumed
