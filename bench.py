@@ -80,6 +80,16 @@ def gate_specs():
         MetricSpec("timings.compute_s", rel_tol=0.35),
         MetricSpec("timings.readback_s", rel_tol=1.00),
         MetricSpec("timings.materialize_s", rel_tol=1.50),
+        # ROADMAP 2(c): the warm-start trajectory.  cold_compile_s is a
+        # fresh process against an EMPTY persistent cache (the ~100s
+        # lax.sort comparator); warm_start_s the fresh-process rebuild
+        # through the cache the cold probe just filled.  Both measured
+        # by subprocess probes (measure_cold_warm), both REQUIRED so a
+        # run that stops reporting them fails loudly; the < 0.2 ratio
+        # is gated separately in main() because it relates the two
+        # keys, which MetricSpec medians cannot.
+        MetricSpec("cold_compile_s", rel_tol=0.75, required=True),
+        MetricSpec("warm_start_s", rel_tol=1.50, required=True),
     ]
 VOCAB = 80_000
 N_PUNCT_VOCAB = 10_000       # vocab entries that are word+punctuation
@@ -155,6 +165,106 @@ def make_corpus(n_words: int = N_WORDS, n_lines: int = N_LINES,
         for w in tail_words:
             tail += w + (b"\n" if r % 3 == 2 else b" ")
     return out.tobytes() + bytes(tail)
+
+
+#: ratio the acceptance gate enforces between the two compile keys: a
+#: warm start that costs more than this fraction of the cold compile
+#: means the persistent cache is not actually serving the programs
+WARM_START_MAX_FRACTION = 0.2
+
+
+def _probe_wordcount(smoke: bool):
+    """The engine the compile probes build: the flagship bench config,
+    or a CPU-seconds-sized one for --smoke (same code path, same cache
+    machinery, just a small sort)."""
+    from mapreduce_tpu.engine import DeviceWordCount
+    from mapreduce_tpu.engine.device_engine import EngineConfig
+    from mapreduce_tpu.engine.wordcount import bench_engine_config
+    from mapreduce_tpu.parallel import make_mesh
+
+    if smoke:
+        cfg = EngineConfig(local_capacity=4096, exchange_capacity=2048,
+                           out_capacity=4096, tile=512, tile_records=104,
+                           combine_in_scan=True, combine_capacity=1024)
+        return DeviceWordCount(make_mesh(), chunk_len=4096, config=cfg)
+    return DeviceWordCount(make_mesh(), chunk_len=1 << 22,
+                           config=bench_engine_config())
+
+
+def compile_probe(cache_dir: str, smoke: bool) -> int:
+    """Subprocess body for the cold/warm measurement: point the
+    persistent cache at *cache_dir* BEFORE any compile (a fresh process
+    is the only place that guarantee holds — XLA latches the cache at
+    its first compile), AOT-build the bench engine program, and print
+    the compile ledger's account as one JSON line."""
+    from mapreduce_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(cache_dir)
+    import jax
+
+    # the probes persist EVERYTHING they compile: the smoke program
+    # compiles in under the default 1s persistence floor, and a warm
+    # probe that finds nothing persisted would measure a second cold
+    # compile and call the cache broken
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    wc = _probe_wordcount(smoke)
+    secs = wc.warm()
+    from mapreduce_tpu.obs.compile import LEDGER
+
+    snap = LEDGER.snapshot()
+    wave = (snap.get("programs") or {}).get("wave") or {}
+    print(json.dumps({
+        "probe_wall_s": round(secs, 3),
+        "compile_s": snap.get("total_compile_s", 0.0),
+        "wave_outcome": ("persistent_hit" if wave.get("persistent_hit")
+                         else "compiled" if wave.get("compiled")
+                         else "cached"),
+        "disk_buckets": snap.get("disk_buckets", 0),
+    }, default=float))
+    return 0
+
+
+def _run_probe(cache_dir: str, smoke: bool) -> dict:
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--compile-probe", cache_dir]
+    if smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"compile probe failed (rc {proc.returncode}): "
+            f"{proc.stderr[-2000:]}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError(f"compile probe printed no JSON: "
+                       f"{proc.stdout[-2000:]}")
+
+
+def measure_cold_warm(smoke: bool) -> dict:
+    """ROADMAP 2(c)'s two gated numbers, measured honestly: a FRESH
+    temp cache dir makes the first fresh-process probe genuinely cold
+    even on a machine whose real cache is warm, and the second probe —
+    a fresh process against the cache the first one filled — is
+    exactly the "warmup → restart" production path warm_start_s claims
+    to measure.  The parent process's own cache config is untouched."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="mrtpu_coldwarm_") as td:
+        cold = _run_probe(td, smoke)
+        warm = _run_probe(td, smoke)
+    return {
+        "cold_compile_s": round(float(cold["compile_s"]), 2),
+        "warm_start_s": round(float(warm["compile_s"]), 2),
+        "cold_outcome": cold.get("wave_outcome"),
+        "warm_outcome": warm.get("wave_outcome"),
+    }
 
 
 def check_smoke() -> int:
@@ -237,6 +347,44 @@ def check_smoke() -> int:
     flops = REGISTRY.sum("mrtpu_device_flops_total") - f0
     assert flops > 0, "device run recorded no FLOPs (cost model broken)"
 
+    # compile-ledger gate (the warm-start story inside ONE process): a
+    # second same-shape engine build must be served by the in-process
+    # ledger — outcome=cached with ZERO new compile-seconds, asserted
+    # purely from the registry (the compile-seconds histogram gains no
+    # observation), never from a wall clock.
+    cached0 = REGISTRY.sum("mrtpu_compile_total", outcome="cached")
+    # compiled OR persistent_hit: both are real ledgered XLA builds —
+    # a developer environment with $JAX_COMPILATION_CACHE_DIR exported
+    # classifies a re-run's first build persistent_hit (the smoke
+    # bucket is already in the shape registry), which must not read as
+    # "the helper is not on the compile path"
+    compiled0 = (REGISTRY.sum("mrtpu_compile_total", program="wave",
+                              outcome="compiled")
+                 + REGISTRY.sum("mrtpu_compile_total", program="wave",
+                                outcome="persistent_hit"))
+    obs0 = REGISTRY.value("mrtpu_compile_seconds", program="wave",
+                          stage="backend_compile")
+    assert compiled0 > 0, (
+        "first engine build recorded no ledgered wave compile — the "
+        "instrumented helper is not on the compile path")
+    wc2 = DeviceWordCount(
+        make_mesh(), chunk_len=4096,
+        config=EngineConfig(local_capacity=4096, exchange_capacity=2048,
+                            out_capacity=4096, tile=512, tile_records=128,
+                            combine_in_scan=True))
+    counts2 = wc2.count_bytes(corpus, waves=3)
+    assert counts2 == counts, "ledger-cached engine diverged"
+    cached_delta = (REGISTRY.sum("mrtpu_compile_total", outcome="cached")
+                    - cached0)
+    assert cached_delta >= 1, (
+        "second same-shape engine build did not report outcome=cached")
+    new_obs = (REGISTRY.value("mrtpu_compile_seconds", program="wave",
+                              stage="backend_compile") - obs0)
+    assert new_obs == 0, (
+        f"second same-shape engine build spent compile-seconds "
+        f"({new_obs} new backend_compile observation(s)) — the "
+        "executable cache is not serving it")
+
     # collector overhead gate: telemetry for the whole engine run must
     # fit a bounded number of push batches (the pusher batches the span
     # ring, it does not chat per span/wave), lose NOTHING in a
@@ -285,6 +433,7 @@ def check_smoke() -> int:
         "dispatches_per_wave": dispatches / waves_ran,
         "device_flops_recorded": flops,
         "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
+        "second_build_cached": cached_delta,
         "telemetry_push_batches": pushes,
         "telemetry_dropped": drops,
         "cluster_timeline_wave_spans": wave_spans,
@@ -439,6 +588,20 @@ def main() -> None:
         print("# WARNING: native oracle unavailable (no g++); "
               "only the total-count check ran", file=sys.stderr)
 
+    # ROADMAP 2(c): cold vs warm compile, measured by two fresh-process
+    # probes against a throwaway cache dir (cold is genuinely cold even
+    # on a machine whose real cache is warm; warm is the literal
+    # "warmup → restarted process" production path).  Runs after the
+    # timed runs so the probes' CPU load cannot touch them.
+    print("# measuring cold/warm compile (two fresh-process probes; "
+          "the cold one pays the full sort-comparator compile) ...",
+          file=sys.stderr, flush=True)
+    coldwarm = measure_cold_warm(smoke="--smoke" in sys.argv)
+    print(f"# cold_compile_s={coldwarm['cold_compile_s']} "
+          f"warm_start_s={coldwarm['warm_start_s']} "
+          f"(warm wave outcome: {coldwarm['warm_outcome']})",
+          file=sys.stderr, flush=True)
+
     result = {
         "metric": "europarl_wordcount_wall_s",
         "value": round(wall, 4),
@@ -466,6 +629,10 @@ def main() -> None:
         "mfu": best.get("mfu"),
         "roofline_frac": best.get("roofline_frac"),
         "cost_source": best.get("cost_source"),
+        # the gated warm-start keys (ROADMAP 2(c))
+        "cold_compile_s": coldwarm["cold_compile_s"],
+        "warm_start_s": coldwarm["warm_start_s"],
+        "warm_outcome": coldwarm["warm_outcome"],
     }
     print(json.dumps(result))
     print(f"# {len(counts)} unique words, {total} total; "
@@ -483,8 +650,20 @@ def main() -> None:
     if "--check" in sys.argv:
         from mapreduce_tpu.obs import benchgate
 
-        problems = benchgate.check_and_append(HISTORY_PATH, result,
-                                              gate_specs())
+        # the warm-start ratio relates two keys of THIS run, which
+        # per-metric history medians cannot express: gate it here, and
+        # keep a ratio-failing run OUT of the history
+        ratio_problems = []
+        if (result["warm_start_s"]
+                >= WARM_START_MAX_FRACTION * result["cold_compile_s"]):
+            ratio_problems.append(
+                f"warm_start_s {result['warm_start_s']} >= "
+                f"{WARM_START_MAX_FRACTION:g} x cold_compile_s "
+                f"{result['cold_compile_s']} — the persistent cache is "
+                "not serving the engine programs")
+        problems = ratio_problems + benchgate.check_and_append(
+            HISTORY_PATH, result, gate_specs(),
+            append=not ratio_problems)
         if problems:
             print("REGRESSION GATE FAILED vs BENCH.json history:",
                   file=sys.stderr)
@@ -496,6 +675,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--compile-probe" in sys.argv:
+        _i = sys.argv.index("--compile-probe")
+        raise SystemExit(compile_probe(sys.argv[_i + 1],
+                                       smoke="--smoke" in sys.argv))
     if "--check" in sys.argv and "--smoke" in sys.argv:
         raise SystemExit(check_smoke())
     main()

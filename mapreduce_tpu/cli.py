@@ -106,6 +106,31 @@ def _retry_policy(args):
     return RetryPolicy(**overrides)
 
 
+def _add_compile_cache(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--compile-cache", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="persistent XLA compilation cache for any jax "
+                        "this process runs (default on; location: "
+                        "$MAPREDUCE_TPU_CACHE, else the package-"
+                        "adjacent .jax_cache, else the user cache "
+                        "dir).  Without it every worker/server process "
+                        "re-pays the ~100s cold compile")
+
+
+def _setup_compile_cache(args) -> Optional[str]:
+    """Wire the persistent cache into a production entrypoint WITHOUT
+    forcing a jax import (jax-free workers stay jax-free: the cache dir
+    travels in $JAX_COMPILATION_CACHE_DIR until jax loads)."""
+    if not getattr(args, "compile_cache", True):
+        return None
+    from .utils.compile_cache import enable_persistent_cache_lazy
+
+    path = enable_persistent_cache_lazy()
+    logging.getLogger("mapreduce_tpu.cli").info(
+        "persistent compile cache at %s", path)
+    return path
+
+
 def _add_trace(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="on exit, write this process's spans as Chrome "
@@ -180,11 +205,13 @@ def cmd_server(argv: List[str]) -> int:
                         "disables; http:// boards only)")
     _add_auth(p)
     _add_retry(p)
+    _add_compile_cache(p)
     _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
     rec = _setup_trace(args)
+    _setup_compile_cache(args)
 
     from .server import Server
 
@@ -241,11 +268,13 @@ def cmd_worker(argv: List[str]) -> int:
                         "disables; http:// boards only)")
     _add_auth(p)
     _add_retry(p)
+    _add_compile_cache(p)
     _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
     rec = _setup_trace(args)
+    _setup_compile_cache(args)
 
     from .worker import Worker, spawn_worker_threads
 
@@ -283,11 +312,13 @@ def cmd_wordcount(argv: List[str]) -> int:
                         "host job-board path")
     p.add_argument("--workers", type=int, default=4)
     p.add_argument("--num-reducers", type=int, default=15)
+    _add_compile_cache(p)
     _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose)
     rec = _setup_trace(args)
+    _setup_compile_cache(args)
 
     import uuid
 
@@ -404,11 +435,13 @@ def cmd_train(argv: List[str]) -> int:
                         "only)")
     _add_auth(p)
     _add_retry(p)
+    _add_compile_cache(p)
     _add_trace(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
     rec = _setup_trace(args)
+    _setup_compile_cache(args)
 
     from . import storage as storage_mod
     from .coord import Connection, TrainerFencedError, TrainerLease
@@ -625,6 +658,60 @@ def _render_device(dev: dict) -> List[str]:
     return lines
 
 
+def _render_compile(comp: dict) -> List[str]:
+    """The compile section of a /statusz snapshot (obs/compile ledger:
+    per-program outcomes + compile seconds + shape buckets)."""
+    if not comp or not comp.get("programs"):
+        return []
+    lines = ["compile ledger ({} bucket(s), {:.1f}s in XLA{}):".format(
+        comp.get("buckets", 0), comp.get("total_compile_s", 0.0),
+        "" if comp.get("cache_dir")
+        else "; persistent cache DISABLED")]
+    for prog, st in sorted(comp["programs"].items()):
+        lines.append(
+            "  {}: {} compiled / {} persistent-hit / {} cached, "
+            "{:.2f}s (last {:.2f}s)".format(
+                prog, st.get("compiled", 0), st.get("persistent_hit", 0),
+                st.get("cached", 0), st.get("compile_s", 0.0),
+                st.get("last_compile_s", 0.0)))
+    return lines
+
+
+def _render_memory(mem: dict) -> List[str]:
+    """The memory section of a /statusz snapshot (obs/memory: live
+    device bytes, per-program footprints, donation savings)."""
+    if not mem:
+        return []
+    lines = ["device memory:"]
+    devices = mem.get("devices") or {}
+    if devices:
+        src = mem.get("device_source", "measured")
+        for dev, st in sorted(devices.items()):
+            limit = st.get("bytes_limit")
+            lines.append(
+                "  device {}: {:.3g} B in use{}{} [{}]".format(
+                    dev, float(st.get("bytes_in_use", 0)),
+                    "" if st.get("peak_bytes_in_use") is None
+                    else " (peak {:.3g})".format(
+                        float(st["peak_bytes_in_use"])),
+                    "" if not limit
+                    else " of {:.3g}".format(float(limit)), src))
+    for prog, m in sorted((mem.get("programs") or {}).items()):
+        lines.append(
+            "  program {}: {:.3g} B footprint (args {:.3g} + out "
+            "{:.3g} + temp {:.3g}) [{}]".format(
+                prog, float(m.get("total", 0)),
+                float(m.get("arguments", 0)), float(m.get("outputs", 0)),
+                float(m.get("temp", 0)), m.get("source", "?")))
+    for prog, s in sorted((mem.get("donation") or {}).items()):
+        lines.append(
+            "  donation {}: {:.3g} B saved of {:.3g} donated [{}]".format(
+                prog, float(s.get("bytes", 0)),
+                float(s.get("donated_bytes", 0)),
+                s.get("source", "?")))
+    return lines
+
+
 def _render_build(build: dict) -> List[str]:
     if not build:
         return []
@@ -686,6 +773,8 @@ def render_status(snap: dict) -> str:
     page role, Dean & Ghemawat §4.6)."""
     lines: List[str] = _render_build(snap.get("build") or {})
     lines += _render_device(snap.get("device") or {})
+    lines += _render_compile(snap.get("compile") or {})
+    lines += _render_memory(snap.get("memory") or {})
     lines += _render_checkpoint(snap.get("checkpoint") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
     tasks = snap.get("tasks", {})
@@ -1034,22 +1123,61 @@ def cmd_warmup(argv: List[str]) -> int:
     p.add_argument("--bench", action="store_true",
                    help="use bench.py's engine capacities instead of the "
                         "DeviceWordCount defaults")
+    p.add_argument("--replay", action="store_true",
+                   help="additionally AOT-prime EVERY bucket the shape "
+                        "registry (obs/compile, written next to the "
+                        "cache) ever recorded on this machine — "
+                        "restarting workers and capacity retries then "
+                        "hit warm programs whatever shapes they ran "
+                        "before, not just the wordcount default")
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
 
-    from .utils.compile_cache import enable_persistent_cache
+    from .utils.compile_cache import enable_persistent_cache, writable_dir
 
     path = enable_persistent_cache(args.cache_dir)
+    if not writable_dir(path):
+        # a warmup that persists nothing is a FAILURE, not a log line:
+        # the ~100s it just spent compiles again in every process
+        print(f"ERROR: compile-cache dir {path!r} is not writable — "
+              "this warmup would persist nothing (set "
+              "$MAPREDUCE_TPU_CACHE or --cache-dir to a writable "
+              "path)", file=sys.stderr)
+        return 1
 
     from .engine import DeviceWordCount
     from .engine.wordcount import bench_engine_config
+    from .obs.compile import LEDGER, registry_path
     from .parallel import make_mesh
 
+    mesh = make_mesh()
     cfg = bench_engine_config() if args.bench else None
-    wc = DeviceWordCount(make_mesh(), chunk_len=args.chunk_len, config=cfg)
+    wc = DeviceWordCount(mesh, chunk_len=args.chunk_len, config=cfg)
     secs = wc.warm()
+    # the seconds land in the metrics registry (mrtpu_compile_seconds /
+    # mrtpu_compile_total via the ledger), not just stdout
+    snap = LEDGER.snapshot()
+    wave = (snap.get("programs") or {}).get("wave") or {}
     print(f"compiled engine programs in {secs:.1f}s -> cache at {path}")
+    print(f"  wave program: {wave.get('compiled', 0)} compiled / "
+          f"{wave.get('persistent_hit', 0)} persistent-cache hit / "
+          f"{wave.get('cached', 0)} cached; shape registry at "
+          f"{registry_path(path)}")
+    if args.replay:
+        from .engine.device_engine import replay_registry
+
+        primed = skipped = 0
+        for row in replay_registry(mesh, path):
+            if "seconds" in row:
+                primed += 1
+                print(f"  replayed {row['program']} bucket "
+                      f"{row['bucket']}: {row['seconds']:.1f}s")
+            else:
+                skipped += 1
+                print(f"  skipped {row['program']} bucket "
+                      f"{row['bucket']}: {row['skipped']}")
+        print(f"replay: {primed} bucket(s) primed, {skipped} skipped")
     return 0
 
 

@@ -63,6 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import compile as _compile_obs
+from ..obs import memory as _memory_obs
 from ..obs import metrics as _obs
 from ..obs import profile as _profile
 from ..obs.trace import TRACER
@@ -152,6 +154,32 @@ class EngineConfig:
         degenerates to a per-chunk dedup — still correct)."""
         cap = self.combine_capacity or max(T // 4, 256)
         return max(1, min(T, cap))
+
+
+#: the wave program's donated positions — the accumulator
+#: (keys/vals/pay/valid) and the wave inputs; n_real (argnum 2) is
+#: reused by every wave and stays undonated.  One constant shared by
+#: _build_wave and the run epilogue's donation accounting, so the two
+#: cannot drift.
+_WAVE_DONATE_ARGNUMS = (0, 1, 3, 4, 5, 6)
+
+
+def _capacities(cfg: EngineConfig) -> dict:
+    """The static capacities a retry right-sizes — the before/after
+    payload of the capacity-retry forensics event."""
+    return {"local_capacity": cfg.local_capacity,
+            "exchange_capacity": cfg.exchange_capacity,
+            "out_capacity": cfg.out_capacity,
+            "tile_records": cfg.tile_records,
+            "combine_capacity": cfg.combine_capacity}
+
+
+def _cfg_token(cfg: EngineConfig) -> str:
+    """Stable cross-process spelling of a config's cache key for the
+    shape-bucket registry (callable reduce ops become module:qualname,
+    never an id()-bearing repr)."""
+    return "|".join(_compile_obs.op_token(v) if callable(v) else repr(v)
+                    for v in cfg.cache_key())
 
 
 def _stage_ops(cfg: EngineConfig):
@@ -322,6 +350,12 @@ class DeviceEngine:
         #: FLOPs up by it
         self.task_label = task or "-"
         self._compiled = {}
+        #: mesh identity for the compile ledger's cross-engine
+        #: executable sharing: two engines with the same map_fn, config
+        #: AND device set run the same program (a mesh over a different
+        #: device subset must not alias)
+        self._mesh_fp = tuple(int(d.id) for d in mesh.devices.flat)
+        self._devices = list(mesh.devices.flat)
 
     # -- the SPMD program --------------------------------------------------
 
@@ -479,8 +513,18 @@ class DeviceEngine:
         # donate the accumulator (its buffers alias the fin outputs —
         # the fold updates it in place) AND the wave inputs (HBM freed
         # the moment the program consumes them, no explicit del dance);
-        # n_real is reused by every wave and stays undonated
-        return jax.jit(fn, donate_argnums=(0, 1, 3, 4, 5, 6))
+        # n_real is reused by every wave and stays undonated.  Routed
+        # through the compile ledger (obs/compile): first-call compiles
+        # emit compile⊃{lowering,backend_compile} spans, land in the
+        # shape-bucket registry, and a second engine with the same
+        # map_fn/config/mesh reuses the executable outright.
+        return _compile_obs.wrap_jit(
+            fn, program="wave",
+            key=("wave", self.map_fn, cfg.cache_key(), self._mesh_fp),
+            bucket_extra=("wave", _compile_obs.op_token(self.map_fn),
+                          _cfg_token(cfg)),
+            replay=lambda structs: self._replay_info(cfg, structs),
+            donate_argnums=_WAVE_DONATE_ARGNUMS)
 
     def _get_compiled(self, cfg: EngineConfig):
         key = cfg.cache_key()
@@ -540,9 +584,12 @@ class DeviceEngine:
         if key not in self._compiled:
             sh = NamedSharding(self.mesh, P(AXIS))
             n_dev = self.n_dev
-            self._compiled[key] = jax.jit(
+            self._compiled[key] = _compile_obs.wrap_jit(
                 lambda: tuple(jnp.zeros((n_dev,) + a.shape, a.dtype)
                               for a in avals),
+                program="acc_init",
+                key=key + (self._mesh_fp,),
+                bucket_extra=("acc_init", _cfg_token(cfg)),
                 out_shardings=(sh,) * 4)
         return list(self._compiled[key]())
 
@@ -589,8 +636,11 @@ class DeviceEngine:
             key = ("host_gather", len(arrays))
             if key not in self._compiled:
                 rep = NamedSharding(self.mesh, P())
-                self._compiled[key] = jax.jit(
-                    lambda *a: a, out_shardings=(rep,) * len(arrays))
+                self._compiled[key] = _compile_obs.wrap_jit(
+                    lambda *a: a, program="host_gather",
+                    key=key + (self._mesh_fp,),
+                    bucket_extra=("host_gather",),
+                    out_shardings=(rep,) * len(arrays))
             arrays = self._compiled[key](*arrays)
         out = [np.asarray(a) for a in arrays]
         return out[0] if len(out) == 1 else out
@@ -662,10 +712,10 @@ class DeviceEngine:
 
     def _program_costs(self, cfg: EngineConfig, shapes) -> dict:
         """FLOPs / bytes-accessed of ONE wave program.  Prefers XLA's
-        own cost model: ``lower().compile()`` on the shapes the run
-        dispatched hits the in-process executable cache (the program
-        already compiled for dispatch — measured ~1ms, not a recompile),
-        and ``cost_analysis()`` reads the compiled module.  Backends
+        own cost model: the ledger's ``aot()`` on the shapes the run
+        dispatched returns the exact executable the run used (the
+        ledger remembered it — zero XLA work, not a recompile), and
+        ``cost_analysis()`` reads the compiled module.  Backends
         without a usable analysis fall back to the analytic
         sort-hierarchy estimate, labelled ``source="analytic"``.
         Cached per (cfg, shape) — one trace per engine config."""
@@ -674,8 +724,7 @@ class DeviceEngine:
         if key not in self._compiled:
             try:
                 with quiet_unusable_donation():
-                    compiled = self._get_compiled(cfg).lower(
-                        *shapes).compile()
+                    compiled = self._get_compiled(cfg).aot(shapes)
                 costs = _profile.program_costs(compiled)
             except Exception:
                 costs = None  # fall through to the analytic estimate
@@ -686,6 +735,48 @@ class DeviceEngine:
                 costs["source"] = "measured"
             self._compiled[key] = costs
         return self._compiled[key]
+
+    def _program_memory(self, cfg: EngineConfig, shapes) -> dict:
+        """HBM footprint of ONE wave program (obs/memory): XLA's
+        ``memory_analysis()`` off the executable the run dispatched,
+        with the labelled analytic fallback for backends without one.
+        Cached per (cfg, shape) like the cost model."""
+        key = ("mem", cfg.cache_key(),
+               tuple((tuple(s.shape), str(s.dtype)) for s in shapes))
+        if key not in self._compiled:
+            mem = None
+            try:
+                with quiet_unusable_donation():
+                    compiled = self._get_compiled(cfg).aot(shapes)
+                mem = _memory_obs.program_memory(compiled)
+            except Exception:
+                mem = None  # fall through to the analytic estimate
+            if mem is None:
+                mem = _memory_obs.analytic_program_memory(shapes)
+            self._compiled[key] = mem
+        return self._compiled[key]
+
+    def _replay_info(self, cfg: EngineConfig, structs):
+        """The shape-bucket registry's replay record: enough to rebuild
+        and AOT-prime this exact wave program in a fresh process
+        (``cli warmup --replay``).  None when the program cannot replay
+        — a lambda map_fn or a callable reduce op has no stable
+        cross-process spelling."""
+        path = _compile_obs.fn_path(self.map_fn)
+        if path is None or not isinstance(cfg.reduce_op, str):
+            return None
+        chunks = structs[0]
+        from dataclasses import asdict
+
+        return {
+            "kind": "device_engine",
+            "map_fn": path,
+            "config": asdict(cfg),
+            "k": int(chunks.shape[0]) // self.n_dev,
+            "row_shape": [int(d) for d in chunks.shape[1:]],
+            "row_dtype": str(chunks.dtype),
+            "n_dev": self.n_dev,
+        }
 
     def _analytic_costs(self, cfg: EngineConfig, shapes) -> dict:
         """Analytic fallback: the record count comes from tracing
@@ -760,7 +851,7 @@ class DeviceEngine:
                                  sharding=row_sh)
             for a in self._fin_row_avals(cfg, row_shape, row_dtype))
         with quiet_unusable_donation():
-            self._get_compiled(cfg).lower(*shapes).compile()
+            self._get_compiled(cfg).aot(shapes)
         return time.monotonic() - t0
 
     def stage_inputs(self, chunks: np.ndarray, waves: int = None):
@@ -798,9 +889,12 @@ class DeviceEngine:
         # staged buffer cannot be produced until the transfers finish
         key = ("stage_barrier", len(resolved))
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(
+            self._compiled[key] = _compile_obs.wrap_jit(
                 lambda *cs: sum(jnp.sum(c[..., ::4096].astype(jnp.int32))
-                                for c in cs))
+                                for c in cs),
+                program="stage_barrier",
+                key=key + (self._mesh_fp,),
+                bucket_extra=("stage_barrier",))
         np.asarray(self._compiled[key](*[ci for ci, _ in resolved]))
         return resolved, n_real
 
@@ -932,6 +1026,16 @@ class DeviceEngine:
                         TRACER.end(sp, tr1)
                         _WAVE_SECONDS.observe(tr1 - sp.t0, stage="wave")
                     _WAVE_SECONDS.observe(tr1 - tr0, stage="readback")
+                    # per-wave HBM gauges: device memory_stats where the
+                    # backend has them, else the engine's own first-party
+                    # estimate (held input waves + the live accumulator),
+                    # labelled analytic so nobody mistakes it
+                    held = feeder.held_bytes if feeder is not None else 0
+                    acc_bytes = sum(int(a.nbytes) for a in acc
+                                    if hasattr(a, "nbytes"))
+                    _memory_obs.sample_device_memory(
+                        self._devices,
+                        analytic_bytes_in_use=held + acc_bytes)
 
                 try:
                     # ONE scoped unusable-donation filter per attempt
@@ -1027,7 +1131,20 @@ class DeviceEngine:
                     break  # done, or out of retries (don't size a cfg
                     # that will never run)
                 retries = attempt + 1
-                cfg = self._resize(cfg, need_arrays)
+                new_cfg = self._resize(cfg, need_arrays)
+                # capacity-retry forensics (obs/memory): one structured
+                # event carrying the attempt's program footprint and the
+                # live device-memory state, so `cli diagnose` can say
+                # whether the retry was HBM-bound or merely out-sized
+                pm = (self._program_memory(cfg, cost_shapes)
+                      if cost_shapes is not None else None)
+                _memory_obs.capacity_retry_event(
+                    task=self.task_label, attempt=attempt,
+                    overflow_rows=total_oflow, program_memory_doc=pm,
+                    devices=self._devices,
+                    old_capacities=_capacities(cfg),
+                    new_capacities=_capacities(new_cfg))
+                cfg = new_cfg
                 del acc, keys, vals, pay, valid
                 # inputs were freed wave by wave: the retry re-uploads
                 if pairs is not None:
@@ -1101,6 +1218,16 @@ class DeviceEngine:
                 n_dev=self.n_dev,
                 device=next(iter(self.mesh.devices.flat)),
                 task=self.task_label)
+            # per-program HBM footprint rides the same timings dict the
+            # cost model does, so the stats doc / statusz per-task
+            # stats carry it (obs/memory publishes the gauges)
+            mem = self._program_memory(cfg, cost_shapes)
+            derived["program_memory_bytes"] = int(mem.get("total", 0))
+            derived["memory_source"] = mem.get("source", "measured")
+            sav = _memory_obs.donation_savings(
+                mem, list(cost_shapes), _WAVE_DONATE_ARGNUMS)
+            _memory_obs.record_donation("wave", sav)
+            derived["donation_saved_bytes"] = int(sav["bytes"])
         if timings is not None:
             timings.update(derived)
             timings["waves"] = W
@@ -1126,3 +1253,51 @@ class DeviceEngine:
                 # would contradict it
                 timings["total_s"] = round(time.monotonic() - t_start, 3)
         return result
+
+
+# -- shape-registry replay (cli warmup --replay) -----------------------------
+
+
+def replay_registry(mesh: Mesh, registry_dir: str = None) -> list:
+    """AOT-prime EVERY replayable bucket in the on-disk shape registry
+    (obs/compile) against *mesh* — the full warm start, not just the
+    DeviceWordCount default.  A bucket replays when it recorded a
+    ``device_engine`` replay spec (importable map_fn, string reduce op)
+    and its device count matches this mesh; anything else is reported
+    as skipped with the reason, never silently dropped.  Returns one
+    result dict per bucket."""
+    from ..obs.compile import LEDGER, resolve_fn
+
+    results = []
+    buckets = LEDGER.disk_buckets(registry_dir)
+    engines: dict = {}
+    for bucket, rec in sorted(buckets.items()):
+        row = {"bucket": bucket, "program": rec.get("program")}
+        replay = rec.get("replay")
+        if not isinstance(replay, dict) or \
+                replay.get("kind") != "device_engine":
+            row["skipped"] = "no replay spec recorded"
+            results.append(row)
+            continue
+        if int(replay.get("n_dev", 0)) != mesh.shape[AXIS]:
+            row["skipped"] = (
+                f"recorded for {replay.get('n_dev')} devices, mesh has "
+                f"{mesh.shape[AXIS]}")
+            results.append(row)
+            continue
+        try:
+            map_fn = resolve_fn(replay["map_fn"])
+            cfg = EngineConfig(**replay["config"])
+            ekey = (replay["map_fn"], _cfg_token(cfg))
+            eng = engines.get(ekey)
+            if eng is None:
+                eng = engines[ekey] = DeviceEngine(mesh, map_fn, cfg)
+            secs = eng.precompile(
+                tuple(replay["row_shape"]),
+                np.dtype(replay["row_dtype"]),
+                k=int(replay["k"]))
+            row["seconds"] = round(secs, 3)
+        except Exception as exc:  # a bad bucket must not stop the rest
+            row["skipped"] = f"replay failed: {exc}"
+        results.append(row)
+    return results

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import compile as _compile_obs
 from ..utils.jax_compat import pcast, shard_map
 
 Params = Dict[str, jax.Array]
@@ -155,8 +156,11 @@ class PipelinedTrainer:
                                   params, grads)
             return params, loss
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
-        self._loss = jax.jit(loss_fn)
+        # ledgered jits (obs/compile): compile spans + seconds + shape
+        # buckets; per-instance (the closure bakes in the lr)
+        self._train_step = _compile_obs.wrap_jit(
+            train_step, program="pipe_step", donate_argnums=(0,))
+        self._loss = _compile_obs.wrap_jit(loss_fn, program="pipe_loss")
 
     def init_params(self) -> Params:
         params = init_pipeline_params(jax.random.key(self.seed), self.cfg,

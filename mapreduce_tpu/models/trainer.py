@@ -43,6 +43,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..coord.lease import TrainerFencedError, TrainerLease
+from ..obs import compile as _compile_obs
+from ..obs import memory as _memory_obs
 from ..obs import metrics as _metrics
 from ..parallel.partition import match_partition_rules, shard_tree
 from ..storage.localdir import LocalDirStorage
@@ -136,7 +138,12 @@ class DistributedTrainer:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+        # ledgered jits (obs/compile): first-call compiles emit spans +
+        # per-program compile-seconds and land in the shape-bucket
+        # registry; no cross-instance key — the closures bake in live
+        # hyperparameters (lr/momentum), so instances must not alias
+        self._train_step = _compile_obs.wrap_jit(
+            train_step, program="mlp_step", donate_argnums=(0, 1))
 
         def train_epoch(params, opt_state, xs, ys):
             """lax.scan of train_step over stacked minibatches
@@ -160,11 +167,14 @@ class DistributedTrainer:
         # lowered module tags them jax.buffer_donor) and the caller-side
         # arrays are consumed — fit() device_puts fresh stacks each
         # epoch anyway, so nothing legitimate reads them back
-        self._train_epoch = jax.jit(train_epoch,
-                                    donate_argnums=(0, 1, 2, 3))
+        self._train_epoch = _compile_obs.wrap_jit(
+            train_epoch, program="mlp_epoch",
+            donate_argnums=(0, 1, 2, 3))
         self.epoch_sharding = NamedSharding(mesh, P(None, "data"))
-        self._eval = jax.jit(
-            lambda p, x, y: loss_and_accuracy(p, x, y, self.mlp_cfg))
+        self._eval = _compile_obs.wrap_jit(
+            lambda p, x, y: loss_and_accuracy(p, x, y, self.mlp_cfg),
+            program="mlp_eval")
+        self._devices = list(mesh.devices.flat)
 
     # -- state placement ---------------------------------------------------
 
@@ -189,8 +199,8 @@ class DistributedTrainer:
         # init included
         opt_specs = match_partition_rules(
             TRAINER_PARTITION_RULES, self.abstract_state())["opt"]
-        opt_state = jax.jit(
-            self.opt.init,
+        opt_state = _compile_obs.wrap_jit(
+            self.opt.init, program="opt_init",
             out_shardings=jax.tree.map(
                 lambda ps: NamedSharding(self.mesh, ps), opt_specs,
                 is_leaf=lambda x: isinstance(x, P)))(params)
@@ -322,6 +332,16 @@ class DistributedTrainer:
                     params, opt_state, xs, ys)
             val_loss, val_acc = self._eval(params, x_va_d, y_va_d)
             val_loss = float(val_loss)
+            # per-epoch HBM gauges (obs/memory): device memory_stats
+            # where the backend has them, else the state+batch bytes
+            # this trainer holds, labelled analytic
+            _memory_obs.sample_device_memory(
+                self._devices,
+                analytic_bytes_in_use=sum(
+                    int(a.nbytes)
+                    for a in jax.tree_util.tree_leaves(
+                        (params, opt_state, xs, ys))
+                    if hasattr(a, "nbytes")))
             rec = {"epoch": epoch,
                    "train_loss": float(np.asarray(losses).mean()),
                    "val_loss": val_loss,

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..utils.jax_compat import shard_map
 
+from ..obs import compile as _compile_obs
 from ..ops.flash_attention import flash_attention
 from ..parallel.ring import ring_attention
 
@@ -377,7 +378,10 @@ class TransformerTrainer:
                                   params, grads)
             return params, loss
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        # ledgered jits (obs/compile): compile spans + seconds + shape
+        # buckets; per-instance (the closures bake in lr and config)
+        self._train_step = _compile_obs.wrap_jit(
+            train_step, program="tf_step", donate_argnums=(0,))
 
         def train_steps(params, xs, ys):
             """S steps in ONE dispatch (lax.scan over the leading step
@@ -391,8 +395,9 @@ class TransformerTrainer:
                 return p, loss
             return jax.lax.scan(body, params, (xs, ys))
 
-        self._train_steps = jax.jit(train_steps, donate_argnums=(0,))
-        self._loss = jax.jit(loss_fn)
+        self._train_steps = _compile_obs.wrap_jit(
+            train_steps, program="tf_steps", donate_argnums=(0,))
+        self._loss = _compile_obs.wrap_jit(loss_fn, program="tf_loss")
         self._pspecs = pspecs
 
         if isinstance(optimizer, str):
@@ -416,8 +421,9 @@ class TransformerTrainer:
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, loss
 
-            self._train_step_opt = jax.jit(train_step_opt,
-                                           donate_argnums=(0, 1))
+            self._train_step_opt = _compile_obs.wrap_jit(
+                train_step_opt, program="tf_step_opt",
+                donate_argnums=(0, 1))
 
     def _place_opt_state(self, opt_state):
         """Pin every optimizer-state leaf to the mesh: leaves living in a

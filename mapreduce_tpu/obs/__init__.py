@@ -10,6 +10,14 @@
 * :mod:`.profile` — device-plane cost model (FLOPs/bytes via XLA
   ``cost_analysis`` with an analytic fallback), MFU/roofline gauges,
   and self-contained profile bundles (trace + metrics + statusz);
+* :mod:`.compile` — the shape-bucket compile ledger: instrumented
+  ``jax.jit``/AOT compiles with ``compile ⊃ {lowering,
+  backend_compile}`` spans, per-program compile-seconds, persistent-
+  cache hit/miss outcomes, and the on-disk shape registry ``warmup
+  --replay`` primes from;
+* :mod:`.memory` — per-program HBM footprints (``memory_analysis``
+  with a labelled analytic fallback), live device-memory gauges,
+  donation accounting, and capacity-retry forensics;
 * :mod:`.collector` — the cluster telemetry plane: span/metric push
   collector with monotonic clock alignment, the merged ``/clusterz``
   timeline assembler, and per-task roll-ups;
@@ -30,6 +38,9 @@ from .trace import TRACE_HEADER, TRACER, Tracer  # noqa: F401
 from .statusz import cluster_status, update_board_gauges  # noqa: F401
 from .profile import (  # noqa: F401
     device_snapshot, load_bundle, validate_trace, write_bundle)
+from .compile import LEDGER, CompileLedger, wrap_jit  # noqa: F401
+from .memory import (  # noqa: F401
+    memory_snapshot, program_memory, sample_device_memory)
 from .collector import (  # noqa: F401
     PROC_ID, Collector, TelemetryPusher, acquire_pusher, release_pusher)
 from .analysis import diagnose, render_diagnosis  # noqa: F401

@@ -248,6 +248,116 @@ def _find_hotspots(doc: Dict[str, Any], top_k: int) -> List[Dict[str, Any]]:
     return hits[:top_k]
 
 
+# -- compile hotspots --------------------------------------------------------
+
+
+def _compile_hotspots(doc: Dict[str, Any],
+                      top_k: int) -> List[Dict[str, Any]]:
+    """Programs ranked by compile seconds: cluster-aggregated
+    ``mrtpu_compile_seconds`` sums when the collector carried them,
+    merged with the merged timeline's ``compile`` spans (which also
+    survive in offline bundles that predate the metrics)."""
+    per: Dict[str, Dict[str, float]] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name != "mrtpu_compile_seconds_sum":
+            continue
+        prog = labels.get("program") or "?"
+        p = per.setdefault(prog, {"total_s": 0.0, "compiles": 0.0,
+                                  "max_s": 0.0})
+        p["total_s"] += value
+    for name, labels, value in _metric_rows(doc):
+        if name != "mrtpu_compile_seconds_count":
+            continue
+        prog = labels.get("program") or "?"
+        if prog in per:
+            # lowering + backend_compile are two observations per
+            # compile; halve so "compiles" means programs built
+            per[prog]["compiles"] += value / 2.0
+    spans: Dict[str, Dict[str, float]] = {}
+    for e in _events(doc):
+        if e.get("name") != "compile":
+            continue
+        args = e.get("args") or {}
+        prog = str(args.get("program") or "?")
+        try:
+            dur = float(e.get("dur", 0.0)) / 1e6
+        except (TypeError, ValueError):
+            continue
+        s = spans.setdefault(prog, {"total_s": 0.0, "n": 0.0,
+                                    "max_s": 0.0})
+        s["total_s"] += dur
+        s["n"] += 1
+        s["max_s"] = max(s["max_s"], dur)
+    for prog, s in spans.items():
+        p = per.setdefault(prog, {"total_s": 0.0, "compiles": 0.0,
+                                  "max_s": 0.0})
+        # spans double the metrics when both are present: the metrics
+        # sums stay authoritative, the FULL span aggregate fills in
+        # for span-only docs (offline bundles predating the metrics)
+        p["max_s"] = max(p["max_s"], s["max_s"])
+        if p["total_s"] <= 0.0:
+            p["total_s"] = s["total_s"]
+            p["compiles"] = s["n"]
+    out = [{"program": prog,
+            "total_s": round(v["total_s"], 4),
+            "compiles": int(v["compiles"]) or None,
+            "max_s": round(v["max_s"], 4) or None}
+           for prog, v in per.items() if v["total_s"] > 0]
+    out.sort(key=lambda h: -h["total_s"])
+    return out[:top_k]
+
+
+# -- memory pressure ---------------------------------------------------------
+
+#: bytes_in_use / bytes_limit above this reads as memory pressure in
+#: the diagnosis notes (matches obs/memory.HBM_PRESSURE_RATIO)
+MEMORY_PRESSURE_RATIO = 0.8
+
+
+def _memory_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Capacity-retry forensics events (the engine's structured
+    ``capacity_retry`` spans) plus live device-memory pressure from the
+    cluster-aggregated gauges."""
+    retries: List[Dict[str, Any]] = []
+    for e in _events(doc):
+        if e.get("name") != "capacity_retry":
+            continue
+        args = e.get("args") or {}
+        retries.append({
+            "task": args.get("task"),
+            "attempt": args.get("attempt"),
+            "overflow_rows": args.get("overflow_rows"),
+            "bound": args.get("bound"),
+            "program_memory": args.get("program_memory"),
+            "device_memory": args.get("device_memory"),
+            "new_capacities": args.get("new_capacities"),
+        })
+    pressure: List[Dict[str, Any]] = []
+    in_use: Dict[str, float] = {}
+    limits: Dict[str, float] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name != "mrtpu_device_memory_bytes":
+            continue
+        dev = labels.get("device") or "?"
+        if labels.get("stat") == "bytes_in_use":
+            in_use[dev] = max(in_use.get(dev, 0.0), value)
+        elif labels.get("stat") == "bytes_limit":
+            limits[dev] = max(limits.get(dev, 0.0), value)
+    for dev, limit in limits.items():
+        used = in_use.get(dev, 0.0)
+        if limit > 0 and used >= MEMORY_PRESSURE_RATIO * limit:
+            pressure.append({"device": dev, "bytes_in_use": int(used),
+                             "bytes_limit": int(limit),
+                             "ratio": round(used / limit, 3)})
+    pressure.sort(key=lambda p: -p["ratio"])
+    out: Dict[str, Any] = {}
+    if retries:
+        out["capacity_retries"] = retries
+    if pressure:
+        out["device_pressure"] = pressure
+    return out
+
+
 # -- phase breakdown ---------------------------------------------------------
 
 _HOST_PHASES = ("claim", "run", "write")
@@ -304,10 +414,55 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "stragglers": stragglers,
         "skew": _find_skew(doc, skew_ratio, top_k),
         "hotspots": _find_hotspots(doc, top_k),
+        "compile_hotspots": _compile_hotspots(doc, top_k),
+        "memory": _memory_findings(doc),
         "phases": _phase_breakdown(doc),
         "trace_events": len(doc.get("traceEvents") or []),
     }
     notes: List[str] = []
+    for r in report["memory"].get("capacity_retries") or []:
+        pm = r.get("program_memory") or {}
+        footprint = pm.get("total")
+        limit = None
+        for entry in ((r.get("device_memory") or {}).get("devices")
+                      or {}).values():
+            if entry.get("bytes_limit"):
+                limit = max(limit or 0, entry["bytes_limit"])
+        if r.get("bound") == "hbm":
+            # the engine classified this retry HBM-bound from live
+            # device stats; never contradict that just because the
+            # program footprint or limit went unrecorded
+            if footprint and limit:
+                notes.append(
+                    "capacity retry on task {} was HBM-bound: program "
+                    "footprint {:.3g} of {:.3g} device bytes".format(
+                        r.get("task"), float(footprint), float(limit)))
+            else:
+                notes.append(
+                    "capacity retry on task {} was HBM-bound "
+                    "(bytes_in_use at >={:.0%} of device capacity; "
+                    "program footprint unrecorded)".format(
+                        r.get("task"), MEMORY_PRESSURE_RATIO))
+        else:
+            notes.append(
+                "capacity retry on task {}: static capacities "
+                "overflowed ({} rows); HBM {} (footprint {})".format(
+                    r.get("task"), r.get("overflow_rows"),
+                    "headroom unknown" if not limit
+                    else "had headroom", footprint))
+    for p in report["memory"].get("device_pressure") or []:
+        notes.append(
+            "device {} memory pressure: {:.3g} of {:.3g} bytes in use "
+            "({:.0%})".format(p["device"], float(p["bytes_in_use"]),
+                              float(p["bytes_limit"]), p["ratio"]))
+    hot_compile = report["compile_hotspots"]
+    if hot_compile and hot_compile[0]["total_s"] >= 5.0:
+        h = hot_compile[0]
+        notes.append(
+            "compile hotspot: program {} spent {:.1f}s in XLA — prime "
+            "it with `cli warmup --replay` so restarts and capacity "
+            "retries hit the persistent cache".format(
+                h["program"], h["total_s"]))
     if not workers:
         notes.append("no worker job latencies found (no job spans and "
                      "no job-seconds metrics in the document)")
@@ -364,6 +519,22 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
             lines.append(f"  {h['metric']}{{{lbl}}} = {h['value']:g}")
     else:
         lines.append("fault/retry hotspots: none")
+
+    comp = report.get("compile_hotspots") or []
+    if comp:
+        lines.append("compile hotspots:")
+        for h in comp:
+            extra = ("" if not h.get("compiles")
+                     else f" over {h['compiles']} compile(s)")
+            lines.append(
+                f"  program {h['program']}: {h['total_s']:.2f}s in "
+                f"XLA{extra}")
+    mem = report.get("memory") or {}
+    for r in mem.get("capacity_retries") or []:
+        lines.append(
+            "  capacity retry [{}]: task {} attempt {} overflowed "
+            "{} rows".format(r.get("bound"), r.get("task"),
+                             r.get("attempt"), r.get("overflow_rows")))
 
     phases = report.get("phases") or {}
     lines.append(

@@ -132,7 +132,53 @@ DIAG_FAMILIES = frozenset({
     "mrtpu_http_exhausted_total",
     "mrtpu_docserver_requests_total",
     "mrtpu_telemetry_dropped_total", "mrtpu_telemetry_pushes_total",
+    # the compile/HBM observability plane: per-process compile seconds
+    # and outcomes, live device memory, donation savings, and the
+    # capacity-retry forensics counter all roll up cluster-wide for
+    # obs/analysis' compile-hotspot and memory-pressure notes
+    "mrtpu_compile_total", "mrtpu_compile_seconds_sum",
+    "mrtpu_compile_seconds_count",
+    "mrtpu_compile_cache_disabled_total",
+    "mrtpu_device_memory_bytes",
+    "mrtpu_device_donation_saved_bytes",
+    "mrtpu_device_capacity_retry_events_total",
 })
+
+#: diagnosis gauges that must merge across processes by MAX, not sum:
+#: the device label is a bare device id, so two hosts' device "0" (or
+#: two procs sharing one chip) land on the SAME label key — summing
+#: would dilute a loaded host's pressure ratio with an idle host's
+#: bytes (or double-count a shared chip), while the worst process's
+#: view is exactly what pressure diagnosis wants
+_DIAG_GAUGE_MAX = frozenset({
+    "mrtpu_device_memory_bytes",
+    "mrtpu_device_donation_saved_bytes",
+})
+
+
+def _proc_obs(parsed: Dict[Any, float]) -> Dict[str, Any]:
+    """Per-process compile/HBM roll-up from one pushed metrics snapshot
+    (the /clusterz and /statusz per-proc rows)."""
+    compile_s = 0.0
+    compiles = 0.0
+    hbm = 0.0
+    for (name, labelkey), value in parsed.items():
+        if name == "mrtpu_compile_seconds_sum":
+            compile_s += value
+        elif name == "mrtpu_compile_total":
+            labels = dict(labelkey)
+            if labels.get("outcome") in ("compiled", "persistent_hit"):
+                compiles += value
+        elif name == "mrtpu_device_memory_bytes":
+            if dict(labelkey).get("stat") == "bytes_in_use":
+                hbm += value
+    out: Dict[str, Any] = {}
+    if compile_s or compiles:
+        out["compile_s"] = round(compile_s, 3)
+        out["compiles"] = int(compiles)
+    if hbm:
+        out["hbm_bytes_in_use"] = int(hbm)
+    return out
 
 
 class Collector:
@@ -323,9 +369,12 @@ class Collector:
         agg: Dict[Tuple[str, Any], float] = {}
         for parsed in snapshots:
             for (name, labelkey), value in parsed.items():
-                if name in DIAG_FAMILIES:
-                    agg[(name, labelkey)] = agg.get((name, labelkey),
-                                                    0.0) + value
+                if name not in DIAG_FAMILIES:
+                    continue
+                prev = agg.get((name, labelkey), 0.0)
+                agg[(name, labelkey)] = (max(prev, value)
+                                         if name in _DIAG_GAUGE_MAX
+                                         else prev + value)
         return [[name, dict(labelkey), value]
                 for (name, labelkey), value in sorted(agg.items())]
 
@@ -341,8 +390,10 @@ class Collector:
         parsed.append(self._parsed_local(registry))
         return {
             "procs": {
-                proc: {k: v for k, v in st.items()
-                       if k not in ("spans", "metrics")}
+                proc: dict(
+                    {k: v for k, v in st.items()
+                     if k not in ("spans", "metrics")},
+                    **_proc_obs(st["metrics"]))
                 for proc, st in snap.items()},
             "tasks": self._rollups(parsed),
         }
@@ -398,6 +449,14 @@ class Collector:
                 "spans": len(st["spans"]),
                 "last_push_age_s": st.get("last_push_age_s"),
             }
+            # per-process compile/HBM roll-up (the local process reads
+            # its live registry; pushed processes their last snapshot)
+            if proc == PROC_ID:
+                procs_out[proc].update(
+                    _proc_obs(self._parsed_local(registry)))
+            else:
+                procs_out[proc].update(_proc_obs(st.get("metrics")
+                                                 or {}))
         parsed = [st["metrics"] for _, st in tracks[1:]
                   if st.get("metrics")]
         parsed.append(self._parsed_local(registry))

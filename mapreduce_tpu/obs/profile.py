@@ -302,6 +302,35 @@ def validate_trace(doc: Any) -> None:
             raise ValueError(f"trace event {i}: bad dur {e['dur']!r}")
 
 
+def validate_compile_ledger(doc: Any) -> None:
+    """Strict structural check of a bundle's ``compile_ledger.json``:
+    every bucket must name its program and carry numeric compile
+    seconds and a memory footprint dict — enforced on write AND reload
+    so a bundle that loads is a bundle the analysis tools accept."""
+    if not isinstance(doc, dict) or doc.get("kind") != \
+            "mrtpu-compile-ledger":
+        raise ValueError("compile ledger: not a mrtpu-compile-ledger "
+                         "document")
+    buckets = doc.get("buckets")
+    if not isinstance(buckets, list):
+        raise ValueError("compile ledger: buckets is not a list")
+    for i, b in enumerate(buckets):
+        if not isinstance(b, dict):
+            raise ValueError(f"compile ledger bucket {i}: not an object")
+        if not b.get("program"):
+            raise ValueError(f"compile ledger bucket {i}: no program")
+        for field in ("compile_s", "lowering_s"):
+            if not isinstance(b.get(field), (int, float)):
+                raise ValueError(
+                    f"compile ledger bucket {i}: bad {field} "
+                    f"{b.get(field)!r}")
+        if not isinstance(b.get("avals"), list):
+            raise ValueError(f"compile ledger bucket {i}: no avals")
+        if not isinstance(b.get("memory"), dict):
+            raise ValueError(
+                f"compile ledger bucket {i}: no memory footprint")
+
+
 def write_bundle(out_dir: str, store: Any = None,
                  metrics_text: Optional[str] = None,
                  statusz_doc: Optional[Dict[str, Any]] = None,
@@ -334,8 +363,16 @@ def write_bundle(out_dir: str, store: Any = None,
             from .statusz import cluster_status
             statusz_doc = cluster_status(store)
         else:
+            from .statusz import compile_snapshot, memory_snapshot_section
+
             statusz_doc = {"tasks": {},
                            "device": device_snapshot(registry)}
+            comp = compile_snapshot()
+            if comp:
+                statusz_doc["compile"] = comp
+            mem = memory_snapshot_section()
+            if mem:
+                statusz_doc["memory"] = mem
     if trace_doc is None:
         trace_doc = tracer.chrome_trace()
     validate_trace(trace_doc)
@@ -353,6 +390,20 @@ def write_bundle(out_dir: str, store: Any = None,
         json.dump(trace_doc, f)
 
     files = ["metrics.prom", "statusz.json", "trace.json"]
+    # the compile ledger (obs/compile): per-shape-bucket compile
+    # seconds, outcomes, per-program memory_analysis footprints and
+    # donation savings — the capturing process's record of what it
+    # lowered and what that cost
+    from .compile import LEDGER
+
+    ledger_doc = {"kind": "mrtpu-compile-ledger", "version": 1,
+                  "snapshot": LEDGER.snapshot(),
+                  "buckets": LEDGER.buckets()}
+    validate_compile_ledger(ledger_doc)
+    with open(os.path.join(out_dir, "compile_ledger.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(ledger_doc, f, indent=1, default=float)
+    files.append("compile_ledger.json")
     if cluster_doc is not None:
         from .analysis import diagnose
 
@@ -408,6 +459,12 @@ def load_bundle(path: str) -> Dict[str, Any]:
         "statusz": statusz_doc,
         "trace": trace_doc,
     }
+    ledger_path = os.path.join(path, "compile_ledger.json")
+    if os.path.exists(ledger_path):
+        with open(ledger_path, encoding="utf-8") as f:
+            ledger_doc = json.load(f)
+        validate_compile_ledger(ledger_doc)
+        out["compile_ledger"] = ledger_doc
     cluster_path = os.path.join(path, "cluster_trace.json")
     if os.path.exists(cluster_path):
         with open(cluster_path, encoding="utf-8") as f:

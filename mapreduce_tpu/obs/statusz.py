@@ -142,6 +142,25 @@ def checkpoint_snapshot(registry: Registry = REGISTRY,
     return snap if any(snap.values()) else {}
 
 
+def compile_snapshot() -> Dict[str, Any]:
+    """The compile section of /statusz: the process's compile-ledger
+    summary (per-program outcomes + compile seconds + shape-bucket
+    counts and the registry/cache locations).  Empty when nothing was
+    ever compiled here — the section then stays off the page."""
+    from .compile import LEDGER  # late: statusz loads in jax-free procs
+
+    snap = LEDGER.snapshot()
+    return snap if snap.get("programs") else {}
+
+
+def memory_snapshot_section() -> Dict[str, Any]:
+    """The memory section of /statusz (obs/memory last-sample mirror:
+    per-device live bytes, per-program footprints, donation savings)."""
+    from .memory import memory_snapshot
+
+    return memory_snapshot()
+
+
 def cluster_status(store, now: Optional[float] = None,
                    collector=None) -> Dict[str, Any]:
     """The /statusz document: one entry per task database on the board,
@@ -162,6 +181,12 @@ def cluster_status(store, now: Optional[float] = None,
     ckpt = checkpoint_snapshot(collector=collector)
     if ckpt:
         out["checkpoint"] = ckpt
+    comp = compile_snapshot()
+    if comp:
+        out["compile"] = comp
+    mem = memory_snapshot_section()
+    if mem:
+        out["memory"] = mem
     if collector is not None:
         out["telemetry"] = collector.summary()
     for db, colls in sorted(_dbnames(store).items()):

@@ -34,7 +34,10 @@ USER_DIR = os.path.join(
     "mapreduce_tpu", "jax_cache")
 
 
-def _writable_dir(path: str) -> bool:
+def writable_dir(path: str) -> bool:
+    """True when *path* exists (or can be created) and accepts writes —
+    the check ``cmd_warmup`` HARD-FAILS on, because a warmup that
+    persists nothing silently re-pays the ~100s compile forever."""
     try:
         os.makedirs(path, exist_ok=True)
         # pid-suffixed: concurrent probers (bench_host's worker fleet)
@@ -51,6 +54,9 @@ def _writable_dir(path: str) -> bool:
         return False
 
 
+_writable_dir = writable_dir  # backward-compatible private alias
+
+
 def enable_persistent_cache(path: Optional[str] = None) -> str:
     """Point XLA's persistent compilation cache at *path* (default:
     $MAPREDUCE_TPU_CACHE, else the package-adjacent ``.jax_cache``,
@@ -58,10 +64,17 @@ def enable_persistent_cache(path: Optional[str] = None) -> str:
     dir).  Idempotent; returns the path."""
     import jax
 
+    path = _resolve_dir(path)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path
+
+
+def _resolve_dir(path: Optional[str] = None) -> str:
     path = path or os.environ.get("MAPREDUCE_TPU_CACHE")
     if not path:
         for cand in (DEFAULT_DIR, USER_DIR):
-            if _writable_dir(cand):
+            if writable_dir(cand):
                 path = cand
                 break
         else:  # nothing writable: persist nowhere, but SAY so
@@ -73,6 +86,30 @@ def enable_persistent_cache(path: Optional[str] = None) -> str:
                 "process will re-pay the ~100s cold compile; set "
                 "$MAPREDUCE_TPU_CACHE to a writable path",
                 DEFAULT_DIR, USER_DIR)
-    jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path
+
+
+def enable_persistent_cache_lazy(path: Optional[str] = None) -> str:
+    """The production-entrypoint form of :func:`enable_persistent_cache`:
+    point the cache WITHOUT forcing a jax import.
+
+    The worker/docserver processes are deliberately jax-free
+    (obs/buildinfo keeps them that way); importing jax just to set a
+    config knob would cost them seconds of startup and megabytes of
+    memory for nothing.  When jax is not yet imported, the cache dir
+    travels in ``$JAX_COMPILATION_CACHE_DIR`` (jax reads it at import
+    time — and XLA initialises the persistent cache lazily at the FIRST
+    compile, so the env var set now governs any jax the process loads
+    later).  When jax IS already imported (embedders, the server's
+    device path), fall through to the config-update form — which must
+    still run before the process's first compile, or XLA has already
+    latched the cache off."""
+    import sys
+
+    path = _resolve_dir(path)
+    if "jax" in sys.modules:
+        return enable_persistent_cache(path)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
     return path

@@ -171,6 +171,12 @@ _MONOTONIC_ONLY_MODULES = {
     # also pins down
     os.path.join("mapreduce_tpu", "obs", "collector.py"),
     os.path.join("mapreduce_tpu", "obs", "analysis.py"),
+    # the compile & HBM observability plane: compile-seconds histograms
+    # and capacity-retry forensics events ARE span/duration data — a
+    # steppable clock would corrupt the compile ledger's seconds and
+    # the forensics timeline alike
+    os.path.join("mapreduce_tpu", "obs", "compile.py"),
+    os.path.join("mapreduce_tpu", "obs", "memory.py"),
     # the elastic training plane: fit()'s recovery gauge and the
     # checkpoint layer feed gated bench numbers (trainer_recovery_s)
     # and step-recovery telemetry — duration math only, so the whole
