@@ -90,6 +90,16 @@ def gate_specs():
         # keys, which MetricSpec medians cannot.
         MetricSpec("cold_compile_s", rel_tol=0.75, required=True),
         MetricSpec("warm_start_s", rel_tol=1.50, required=True),
+        # comms observability (obs/comms): recv-side exchange imbalance
+        # (max-row/mean-row of the device traffic matrix; 1.0 on the
+        # single-chip fixture, and a skew regression on a real mesh
+        # must not merge silently) and the feeder-effectiveness
+        # fraction (staged runs upload nothing mid-run, so ~1.0; a
+        # feeder regression shows as the fraction collapsing).  Both
+        # REQUIRED: a run that stops reporting them fails loudly.
+        MetricSpec("exchange_imbalance", rel_tol=0.50, required=True),
+        MetricSpec("upload_overlap_frac", rel_tol=0.90,
+                   direction="higher", required=True),
     ]
 VOCAB = 80_000
 N_PUNCT_VOCAB = 10_000       # vocab entries that are word+punctuation
@@ -324,6 +334,7 @@ def check_smoke() -> int:
     f0 = REGISTRY.sum("mrtpu_device_flops_total")
     w0 = REGISTRY.sum("mrtpu_device_waves_total")
     d0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    er0 = REGISTRY.sum("mrtpu_exchange_records_total")
     tm = {}
     counts = wc.count_bytes(corpus, timings=tm, waves=3)
     assert counts[b"alpha"] == 3000, counts.get(b"alpha")
@@ -346,6 +357,30 @@ def check_smoke() -> int:
         "two-dispatch wave fold came back")
     flops = REGISTRY.sum("mrtpu_device_flops_total") - f0
     assert flops > 0, "device run recorded no FLOPs (cost model broken)"
+
+    # comms observability gate (registry-only, zero wall clock): the
+    # exchange traffic matrix rode the ONE n_live readback of the run
+    # just asserted to dispatch exactly one program per wave — and its
+    # row sums equal the records the run actually processed, derived
+    # on the host from the same chunk/wave split (engine local reduce =
+    # per-device-per-wave unique words, routed by hash).
+    host_m = wc.host_exchange_matrix(corpus, waves=3)
+    sent = REGISTRY.sum("mrtpu_exchange_records_total") - er0
+    assert sent == tm["exchange_records"] == int(host_m.sum()) > 0, (
+        f"exchange matrix total {tm.get('exchange_records')} (registry "
+        f"delta {sent}) != host-derived records processed "
+        f"{int(host_m.sum())}")
+    smoke_m = np.asarray(tm["exchange"]["matrix"], dtype=np.int64)
+    assert np.array_equal(smoke_m, host_m), (
+        "smoke exchange matrix diverged from the host recompute")
+    assert 0.0 <= tm["upload_overlap_frac"] <= 1.0, tm
+    # the two gated comms keys must have seeded history to baseline on
+    for key in ("exchange_imbalance", "upload_overlap_frac"):
+        assert any(benchgate.lookup(h, key) is not None
+                   for h in history), (
+            f"no BENCH.json history entry carries {key!r}")
+        assert benchgate.lookup(tm, key) is not None, (
+            f"run timings missing gated comms key {key!r}")
 
     # compile-ledger gate (the warm-start story inside ONE process): a
     # second same-shape engine build must be served by the in-process
@@ -434,6 +469,9 @@ def check_smoke() -> int:
         "device_flops_recorded": flops,
         "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
         "second_build_cached": cached_delta,
+        "exchange_records": tm["exchange_records"],
+        "exchange_imbalance": tm["exchange_imbalance"],
+        "upload_overlap_frac": tm["upload_overlap_frac"],
         "telemetry_push_batches": pushes,
         "telemetry_dropped": drops,
         "cluster_timeline_wave_spans": wave_spans,
@@ -633,6 +671,13 @@ def main() -> None:
         "cold_compile_s": coldwarm["cold_compile_s"],
         "warm_start_s": coldwarm["warm_start_s"],
         "warm_outcome": coldwarm["warm_outcome"],
+        # the gated comms keys (obs/comms): recv-side exchange
+        # imbalance of the device traffic matrix and the feeder
+        # overlap fraction of the best run
+        "exchange_imbalance": best.get("exchange_imbalance"),
+        "upload_overlap_frac": best.get("upload_overlap_frac"),
+        "exchange_records": best.get("exchange_records"),
+        "modeled_exchange_s": best.get("modeled_exchange_s"),
     }
     print(json.dumps(result))
     print(f"# {len(counts)} unique words, {total} total; "

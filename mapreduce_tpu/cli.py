@@ -712,6 +712,42 @@ def _render_memory(mem: dict) -> List[str]:
     return lines
 
 
+def _render_comms(comms: dict) -> List[str]:
+    """The comms section of a /statusz snapshot (obs/comms: exchange
+    traffic matrix roll-ups, link-class bytes, upload overlap)."""
+    if not comms:
+        return []
+    lines = ["comms (exchange & dataflow):"]
+    ex = comms.get("exchange") or {}
+    if ex:
+        lines.append(
+            "  exchange: {} records / {:.3g} B over {} partition(s), "
+            "imbalance send {:.2f}x / recv {:.2f}x (hot dst D{:03d} at "
+            "{:.1%})".format(
+                ex.get("records", 0), float(ex.get("bytes", 0)),
+                ex.get("partitions", 0),
+                ex.get("imbalance_send", 1.0),
+                ex.get("imbalance_recv", 1.0),
+                int(ex.get("hot_dst", 0)),
+                ex.get("hot_dst_share", 0.0)))
+        link = ex.get("bytes_by_link") or {}
+        if link:
+            lines.append("  bytes by link: " + "  ".join(
+                f"{cls} {int(v):,}" for cls, v in sorted(link.items())))
+        if ex.get("modeled_exchange_s") is not None:
+            lines.append(
+                "  modeled exchange {:.4g}s = {:.1%} of measured "
+                "compute [analytic, peaks: {}]".format(
+                    ex.get("modeled_exchange_s", 0.0),
+                    ex.get("exchange_frac_of_compute", 0.0),
+                    ex.get("peak_source", "?")))
+    if comms.get("upload_overlap_frac") is not None:
+        lines.append("  upload overlap: {:.1%} of upload waiting hid "
+                     "under device execution".format(
+                         comms["upload_overlap_frac"]))
+    return lines
+
+
 def _render_build(build: dict) -> List[str]:
     if not build:
         return []
@@ -775,6 +811,7 @@ def render_status(snap: dict) -> str:
     lines += _render_device(snap.get("device") or {})
     lines += _render_compile(snap.get("compile") or {})
     lines += _render_memory(snap.get("memory") or {})
+    lines += _render_comms(snap.get("comms") or {})
     lines += _render_checkpoint(snap.get("checkpoint") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
     tasks = snap.get("tasks", {})

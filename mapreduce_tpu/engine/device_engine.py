@@ -63,6 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import comms as _comms
 from ..obs import compile as _compile_obs
 from ..obs import memory as _memory_obs
 from ..obs import metrics as _obs
@@ -138,6 +139,13 @@ class EngineConfig:
     #: False restores the variadic all-lanes sort — kept for the
     #: golden-equivalence suite, not for production use
     rank_sort: bool = True
+    #: exchange traffic matrix (obs/comms): accumulate, on device, a
+    #: P×P src×dst matrix of records each device routed to each
+    #: partition — an extra tiny donated lane of the fused wave
+    #: program, read back once per run with n_live.  Default on; the
+    #: golden suite pins that it never changes fold values, and the
+    #: bench smoke that it adds no dispatches.
+    exchange_stats: bool = True
 
     def cache_key(self):
         # the op object itself is part of the key: keeping it in the
@@ -146,7 +154,8 @@ class EngineConfig:
         return (self.local_capacity, self.exchange_capacity,
                 self.out_capacity, self.tile, self.tile_records,
                 self.reduce_op, self.unit_values, self.combine_in_scan,
-                self.combine_capacity, self.rank_sort)
+                self.combine_capacity, self.rank_sort,
+                self.exchange_stats)
 
     def scan_combine_slots(self, T: int) -> int:
         """Static buffer slots one chunk's pre-reduced records occupy
@@ -158,10 +167,17 @@ class EngineConfig:
 
 #: the wave program's donated positions — the accumulator
 #: (keys/vals/pay/valid) and the wave inputs; n_real (argnum 2) is
-#: reused by every wave and stays undonated.  One constant shared by
-#: _build_wave and the run epilogue's donation accounting, so the two
-#: cannot drift.
+#: reused by every wave and stays undonated.  One source shared by
+#: _program and the run epilogue's donation accounting, so the two
+#: cannot drift.  With exchange_stats the traffic-matrix accumulator
+#: rides as donated argnum 7 (it aliases the program's traffic output
+#: exactly as the record accumulator aliases the fold outputs).
 _WAVE_DONATE_ARGNUMS = (0, 1, 3, 4, 5, 6)
+
+
+def _wave_donate_argnums(cfg: "EngineConfig"):
+    return (_WAVE_DONATE_ARGNUMS + (7,) if cfg.exchange_stats
+            else _WAVE_DONATE_ARGNUMS)
 
 
 def _capacities(cfg: EngineConfig) -> dict:
@@ -366,7 +382,7 @@ class DeviceEngine:
         def per_device(chunks: jax.Array, chunk_idx: jax.Array,
                        n_real: jax.Array, acc_k: jax.Array,
                        acc_v: jax.Array, acc_p: jax.Array,
-                       acc_valid: jax.Array):
+                       acc_valid: jax.Array, *acc_tr: jax.Array):
             # chunks: [k, ...chunk_shape], chunk_idx: [k] global indices,
             # n_real: [] count of genuine chunks — indices >= n_real are
             # padding added to even out the mesh; their records (and any
@@ -499,16 +515,26 @@ class DeviceEngine:
                                fin.n_unique, map_oflow, comb_max])
             # keep leading device axis for the host: [1, ...] per shard
             expand = lambda a: a[None]
-            return (expand(fin.keys), expand(fin.values),
+            outs = (expand(fin.keys), expand(fin.values),
                     expand(fin.payload), expand(fin.valid),
                     expand(local_oflow), expand(needs))
+            if cfg.exchange_stats:
+                # the exchange traffic matrix (obs/comms): this device's
+                # per-destination routed-row counts — already computed by
+                # the exchange for overflow accounting — accumulated into
+                # the donated [1, P] running row across waves.  A tiny
+                # extra output lane of the SAME dispatch, read back once
+                # per run with n_live: no new program, no new readback.
+                outs = outs + (acc_tr[0] + ex.counts[None, :],)
+            return outs
 
         sharded = P(AXIS)
+        n_extra = 1 if cfg.exchange_stats else 0
         fn = shard_map(
             per_device, mesh=self.mesh,
             in_specs=(sharded, sharded, P(), sharded, sharded, sharded,
-                      sharded),
-            out_specs=(sharded,) * 6,
+                      sharded) + (sharded,) * n_extra,
+            out_specs=(sharded,) * (6 + n_extra),
         )
         # donate the accumulator (its buffers alias the fin outputs —
         # the fold updates it in place) AND the wave inputs (HBM freed
@@ -524,7 +550,7 @@ class DeviceEngine:
             bucket_extra=("wave", _compile_obs.op_token(self.map_fn),
                           _cfg_token(cfg)),
             replay=lambda structs: self._replay_info(cfg, structs),
-            donate_argnums=_WAVE_DONATE_ARGNUMS)
+            donate_argnums=_wave_donate_argnums(cfg))
 
     def _get_compiled(self, cfg: EngineConfig):
         key = cfg.cache_key()
@@ -577,8 +603,13 @@ class DeviceEngine:
         """Fresh all-invalid accumulator ``[n_dev, C, ...]`` arrays for
         an attempt — built ON DEVICE by a cached zeros program with the
         run's shardings (never a multi-megabyte host transfer of zeros
-        over the slow link)."""
+        over the slow link).  With ``exchange_stats`` the zeroed
+        ``[n_dev, P]`` traffic-matrix accumulator rides along as a fifth
+        array."""
         avals = self._fin_row_avals(cfg, row_shape, row_dtype)
+        if cfg.exchange_stats:
+            avals = avals + (
+                jax.ShapeDtypeStruct((self.n_dev,), np.int32),)
         key = ("acc_init", cfg.cache_key(),
                tuple((a.shape, str(a.dtype)) for a in avals))
         if key not in self._compiled:
@@ -590,7 +621,7 @@ class DeviceEngine:
                 program="acc_init",
                 key=key + (self._mesh_fp,),
                 bucket_extra=("acc_init", _cfg_token(cfg)),
-                out_shardings=(sh,) * 4)
+                out_shardings=(sh,) * len(avals))
         return list(self._compiled[key]())
 
     # -- host driver -------------------------------------------------------
@@ -850,6 +881,9 @@ class DeviceEngine:
             jax.ShapeDtypeStruct((self.n_dev,) + a.shape, a.dtype,
                                  sharding=row_sh)
             for a in self._fin_row_avals(cfg, row_shape, row_dtype))
+        if cfg.exchange_stats:
+            shapes += (jax.ShapeDtypeStruct(
+                (self.n_dev, self.n_dev), np.int32, sharding=row_sh),)
         with quiet_unusable_donation():
             self._get_compiled(cfg).aot(shapes)
         return time.monotonic() - t0
@@ -998,6 +1032,15 @@ class DeviceEngine:
                 wave_oflows = []
                 wave_oflow_vals = {}
                 need_arrays = []
+                # upload/compute overlap accounting (obs/comms): the
+                # attempt's upload-wait intervals and a device-busy
+                # proxy per wave (dispatch -> the readback that proved
+                # the wave's device work finished).  Reset per attempt:
+                # the FINAL attempt's feeder behaviour is the one the
+                # overlap fraction reports, matching the cost model.
+                upload_ivals = []
+                busy_ivals = []
+                dispatch_t = {}
                 # per-attempt span tree: device_run ⊃ wave ⊃ {upload,
                 # compute, readback}, joined (via the thread's current
                 # span) under the owning job's trace.  Waves OVERLAP —
@@ -1026,6 +1069,10 @@ class DeviceEngine:
                         TRACER.end(sp, tr1)
                         _WAVE_SECONDS.observe(tr1 - sp.t0, stage="wave")
                     _WAVE_SECONDS.observe(tr1 - tr0, stage="readback")
+                    if j in dispatch_t:
+                        # wave j's device-busy proxy: its program was in
+                        # flight from dispatch until this readback
+                        busy_ivals.append((dispatch_t.pop(j), tr1))
                     # per-wave HBM gauges: device memory_stats where the
                     # backend has them, else the engine's own first-party
                     # estimate (held input waves + the live accumulator),
@@ -1062,6 +1109,7 @@ class DeviceEngine:
                                                     start=tb), t_up)
                             _WAVE_SECONDS.observe(t_up - tb, stage="upload")
                             t_blocked += t_up - tb
+                            upload_ivals.append((tb, t_up))
                             if w >= depth:
                                 # bound the dispatch queue via a VALUE
                                 # readback: on the tunnelled platform
@@ -1087,7 +1135,12 @@ class DeviceEngine:
                                             task=self.task_label)
                             wave_oflows.append(out[4])
                             need_arrays.append(out[5])
-                            acc = list(out[:4])
+                            # lanes 0-3 are the record accumulator; lane
+                            # 6 (when exchange_stats) the traffic-matrix
+                            # accumulator — both thread into the next
+                            # wave in arg order
+                            acc = list(out[:4]) + list(out[6:])
+                            dispatch_t[w] = tc0
                             tc1 = time.monotonic()
                             TRACER.end(TRACER.begin("compute",
                                                     parent=wave_spans[w],
@@ -1104,7 +1157,8 @@ class DeviceEngine:
                             else:
                                 feeder.release(w)
                             del ci, ii
-                    keys, vals, pay, valid = acc
+                    keys, vals, pay, valid = acc[:4]
+                    traffic = acc[4] if cfg.exchange_stats else None
                     # the (tiny) overflow readbacks force program
                     # completion — and close each wave's span.  The
                     # fold's overflow is already inside each wave's
@@ -1145,7 +1199,7 @@ class DeviceEngine:
                     old_capacities=_capacities(cfg),
                     new_capacities=_capacities(new_cfg))
                 cfg = new_cfg
-                del acc, keys, vals, pay, valid
+                del acc, keys, vals, pay, valid, traffic
                 # inputs were freed wave by wave: the retry re-uploads
                 if pairs is not None:
                     if chunks is None:
@@ -1172,10 +1226,17 @@ class DeviceEngine:
                 "capacities (or max_retries), or pass "
                 "on_overflow='return' to inspect the truncated result")
         # sliced readback: only the live prefix of each partition's
-        # capacity-padded result crosses the (slow) device->host link
+        # capacity-padded result crosses the (slow) device->host link.
+        # The exchange traffic matrix rides the SAME n_live fetch: one
+        # batched gather, not a second readback.
         t0 = time.monotonic()
+        traffic_h = None
         with TRACER.span("readback", stage="result"):
-            n_live = self._host(valid.sum(axis=1))
+            if traffic is not None:
+                n_live, traffic_h = self._host(valid.sum(axis=1),
+                                               traffic)
+            else:
+                n_live = self._host(valid.sum(axis=1))
             width = max(1, int(n_live.max()))
             keys_h, vals_h, pay_h, valid_h = self._host(
                 keys[:, :width], vals[:, :width], pay[:, :width],
@@ -1204,6 +1265,26 @@ class DeviceEngine:
             _PARTITION_BYTES.set(int(n) * row_bytes,
                                  task=self.task_label,
                                  partition=f"P{p:05d}")
+        # comms observability (obs/comms): the run's exchange traffic
+        # matrix -> per-(src,dst) counters, imbalance gauges, link-class
+        # roll-up + modeled exchange seconds vs this attempt's compute;
+        # and the feeder-effectiveness number — how much of the upload
+        # waiting hid under device execution.  On a multi-controller
+        # mesh every process holds the identical replicated matrix (the
+        # _host all-gather), and the collector SUMS counter families
+        # across processes — so only process 0 publishes the matrix, or
+        # /clusterz would report N_procs x the true traffic.  The
+        # timings dict still carries it everywhere (SPMD-consistent).
+        comms_derived: dict = {}
+        if traffic_h is not None:
+            comms_derived = _comms.record_exchange(
+                np.asarray(traffic_h).tolist(), row_bytes=row_bytes,
+                task=self.task_label, devices=self._devices,
+                compute_s=t_attempt_compute,
+                publish=jax.process_index() == 0)
+        overlap = _comms.record_upload_overlap(
+            _comms.overlap_fraction(upload_ivals, busy_ivals),
+            task=self.task_label)
         # cost model: FLOPs/bytes of the final wave program (XLA
         # cost_analysis, analytic fallback on backends without one) ->
         # flop/byte counters + derived MFU / roofline gauges.  The MFU
@@ -1225,11 +1306,13 @@ class DeviceEngine:
             derived["program_memory_bytes"] = int(mem.get("total", 0))
             derived["memory_source"] = mem.get("source", "measured")
             sav = _memory_obs.donation_savings(
-                mem, list(cost_shapes), _WAVE_DONATE_ARGNUMS)
+                mem, list(cost_shapes), _wave_donate_argnums(cfg))
             _memory_obs.record_donation("wave", sav)
             derived["donation_saved_bytes"] = int(sav["bytes"])
         if timings is not None:
             timings.update(derived)
+            timings.update(comms_derived)
+            timings["upload_overlap_frac"] = round(overlap, 4)
             timings["waves"] = W
             timings["retries"] = retries
             if feeder is not None:

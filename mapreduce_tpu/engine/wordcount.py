@@ -241,6 +241,50 @@ class DeviceWordCount:
             timings["materialize_s"] = round(time.monotonic() - t0, 3)
         return out
 
+    def host_exchange_matrix(self, data: bytes,
+                             waves: Optional[int] = None) -> np.ndarray:
+        """Host recompute of the exchange traffic matrix a
+        ``count_bytes(data, waves=waves)`` run accumulates on device
+        (obs/comms): per wave, each device's buffer holds its chunks'
+        records, the local reduce collapses them to the device's unique
+        hash keys, and every unique routes to partition ``k1 % P`` —
+        so entry ``[src][dst]`` is the number of distinct word keys of
+        *src*'s per-wave chunk block whose hash lands on *dst*, summed
+        over waves.  Pure numpy/Python over the SAME chunking the run
+        uses; the comms test suite, the multichip dryrun and the bench
+        smoke assert bit-equality against the device matrix."""
+        from ..ops.tokenize import word_hashes_host
+
+        chunks, L = self._to_chunks(data)
+        eng = self._engine_for(L)
+        n_dev = eng.n_dev
+        S = chunks.shape[0]
+        if waves is None:
+            k = eng._auto_rows(chunks)
+        else:
+            k = -(-S // (max(1, waves) * n_dev))
+        rpw = k * n_dev
+        matrix = np.zeros((n_dev, n_dev), dtype=np.int64)
+        for w in range(-(-S // rpw)):
+            for d in range(n_dev):
+                lo = w * rpw + d * k
+                block = chunks[lo:min(lo + k, S)]
+                if block.size == 0:
+                    continue
+                words: set = set()
+                for row in block:
+                    # per row, never concatenated: a chunk whose content
+                    # runs to its final byte must not merge its last
+                    # word with the next chunk's first
+                    words.update(row.tobytes().split())
+                # dedupe by the (k1, k2) KEY pair exactly as the device
+                # local reduce does (two words colliding on both lanes
+                # would be one device record), then route by k1 % P
+                keys = set(word_hashes_host(b" ".join(words)).values())
+                for k1, _k2 in keys:
+                    matrix[d, k1 % n_dev] += 1
+        return matrix
+
     def _row_len(self) -> int:
         """The ONE padded chunk length every corpus maps to: chunk_len
         plus one tile of slack for the whitespace-boundary overhang

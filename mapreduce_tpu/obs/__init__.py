@@ -18,6 +18,10 @@
 * :mod:`.memory` — per-program HBM footprints (``memory_analysis``
   with a labelled analytic fallback), live device-memory gauges,
   donation accounting, and capacity-retry forensics;
+* :mod:`.comms` — exchange & dataflow observability: the device
+  traffic matrix (src×dst records/bytes + imbalance gauges), the
+  link-class comms roofline over ``parallel.mesh``'s topology model,
+  and the upload/compute overlap fraction;
 * :mod:`.collector` — the cluster telemetry plane: span/metric push
   collector with monotonic clock alignment, the merged ``/clusterz``
   timeline assembler, and per-task roll-ups;
@@ -41,6 +45,8 @@ from .profile import (  # noqa: F401
 from .compile import LEDGER, CompileLedger, wrap_jit  # noqa: F401
 from .memory import (  # noqa: F401
     memory_snapshot, program_memory, sample_device_memory)
+from .comms import (  # noqa: F401
+    comms_snapshot, overlap_fraction, record_exchange, validate_comms)
 from .collector import (  # noqa: F401
     PROC_ID, Collector, TelemetryPusher, acquire_pusher, release_pusher)
 from .analysis import diagnose, render_diagnosis  # noqa: F401

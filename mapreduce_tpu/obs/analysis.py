@@ -183,6 +183,18 @@ def _find_stragglers(doc: Dict[str, Any]) -> Tuple[List[Dict[str, Any]],
 # -- partition skew ----------------------------------------------------------
 
 
+def _matrix_part(label: Optional[str]) -> Optional[str]:
+    """An exchange-matrix ``dst`` label (``D00005``) as the partition
+    spelling the counters use (``P00005``) — partition p IS device p on
+    the device plane."""
+    if not label or not label.startswith("D"):
+        return label
+    try:
+        return f"P{int(label[1:]):05d}"
+    except ValueError:
+        return label
+
+
 def _find_skew(doc: Dict[str, Any], skew_ratio: float,
                top_k: int) -> List[Dict[str, Any]]:
     # plane -> task -> partition -> records
@@ -204,6 +216,27 @@ def _find_skew(doc: Dict[str, Any], skew_ratio: float,
             continue
         d = dst.setdefault((plane, task), {})
         d[part] = d.get(part, 0.0) + value
+    source: Dict[Tuple[str, str], str] = {
+        key: ("partition_gauges" if key[0] == "device"
+              else "partition_counters")
+        for key in counts}
+    if not any(plane == "device" for plane, _t in counts):
+        # fallback: no device partition gauges survived (the engine
+        # process's push was lost, or an older engine) — the exchange
+        # traffic matrix's recv totals (column sums: records routed TO
+        # each partition) carry the same skew signal.  Entries say so.
+        for name, labels, value in _metric_rows(doc):
+            if name not in ("mrtpu_exchange_records_total",
+                            "mrtpu_exchange_bytes_total"):
+                continue
+            dst = (counts if name.endswith("records_total") else nbytes)
+            task = labels.get("task") or "-"
+            part = _matrix_part(labels.get("dst"))
+            if part is None:
+                continue
+            d = dst.setdefault(("device", task), {})
+            d[part] = d.get(part, 0.0) + value
+            source[("device", task)] = "exchange_matrix"
     skewed: List[Dict[str, Any]] = []
     for (plane, task), parts in counts.items():
         total = sum(parts.values())
@@ -223,6 +256,7 @@ def _find_skew(doc: Dict[str, Any], skew_ratio: float,
                     "uniform_share": round(uniform, 4),
                     "ratio_vs_uniform": round(share / uniform, 2),
                     "partitions_observed": n,
+                    "source": source.get((plane, task), "?"),
                 })
     skewed.sort(key=lambda s: -s["share"])
     return skewed[:top_k]
@@ -358,6 +392,196 @@ def _memory_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# -- comms: exchange imbalance + upload/compute overlap ----------------------
+
+#: recv-side imbalance (max over mean) at or above this reads as an
+#: exchange-imbalance note in the diagnosis
+EXCHANGE_IMBALANCE_NOTE_RATIO = 2.0
+
+#: a run whose upload waiting overlapped device execution less than
+#: this — while upload was a nontrivial share of the busy time — reads
+#: as feeder-bound
+OVERLAP_FEEDER_BOUND_FRAC = 0.5
+
+
+def _comms_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Exchange traffic roll-ups from the cluster-aggregated matrix
+    counters: per-task totals, send/recv imbalance, the hottest
+    destination device, per-link-class bytes, and the modeled exchange
+    seconds (obs/comms gauges)."""
+    sent: Dict[str, Dict[str, float]] = {}
+    recv: Dict[str, Dict[str, float]] = {}
+    by_link: Dict[str, float] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name == "mrtpu_exchange_records_total":
+            task = labels.get("task") or "-"
+            s = labels.get("src") or "?"
+            d = labels.get("dst") or "?"
+            srow = sent.setdefault(task, {})
+            srow[s] = srow.get(s, 0.0) + value
+            drow = recv.setdefault(task, {})
+            drow[d] = drow.get(d, 0.0) + value
+        elif name == "mrtpu_comms_bytes_total":
+            link = labels.get("link") or "?"
+            by_link[link] = by_link.get(link, 0.0) + value
+    tasks: Dict[str, Any] = {}
+    for task, drow in recv.items():
+        total = sum(drow.values())
+        if total <= 0 or not drow:
+            continue
+        hot = max(drow, key=drow.get)
+        srow = sent.get(task, {})
+        # zero cells never become counter rows, so the destination list
+        # alone under-counts the device universe (and under-reports
+        # imbalance); the union with the senders recovers every device
+        # that touched the exchange at all
+        n = len(set(drow) | set(srow))
+        mean = total / n
+        tasks[task] = {
+            "records": int(total),
+            "devices_observed": n,
+            "imbalance_recv": round(max(drow.values()) / mean, 2),
+            "imbalance_send": (round(max(srow.values()) * n / total, 2)
+                               if srow else None),
+            "hot_dst": hot,
+            "hot_dst_records": int(drow[hot]),
+            "hot_dst_share": round(drow[hot] / total, 4),
+        }
+    out: Dict[str, Any] = {}
+    if tasks:
+        out["exchange"] = tasks
+    if by_link:
+        out["bytes_by_link"] = {k: int(v)
+                                for k, v in sorted(by_link.items())}
+    for gauge_name, field in (
+            ("mrtpu_comms_modeled_exchange_seconds",
+             "modeled_exchange_s"),
+            ("mrtpu_comms_exchange_frac_of_compute",
+             "exchange_frac_of_compute")):
+        vals = [v for name, _l, v in _metric_rows(doc)
+                if name == gauge_name]
+        if vals:
+            out[field] = round(max(vals), 6)
+    return out
+
+
+def _union_ivals(events: List[Dict[str, Any]],
+                 name: str) -> List[Tuple[float, float]]:
+    """``(t0, t1)`` second-intervals of every complete span named
+    *name* in the merged doc."""
+    out: List[Tuple[float, float]] = []
+    for e in events:
+        if e.get("name") != name:
+            continue
+        try:
+            t0 = float(e["ts"]) / 1e6
+            out.append((t0, t0 + float(e.get("dur", 0.0)) / 1e6))
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def _busy_ivals(events: List[Dict[str, Any]],
+                ) -> List[Tuple[float, float]]:
+    """Device-busy proxies: for each ``wave`` span, dispatch (its
+    ``compute`` child's start) to the wave's end (the readback that
+    proved its device work finished); waves without a matched compute
+    child contribute their whole interval."""
+    waves: Dict[str, Tuple[float, float]] = {}
+    for e in events:
+        if e.get("name") != "wave":
+            continue
+        sid = (e.get("args") or {}).get("span_id")
+        try:
+            t0 = float(e["ts"]) / 1e6
+            waves[str(sid)] = (t0, t0 + float(e.get("dur", 0.0)) / 1e6)
+        except (KeyError, TypeError, ValueError):
+            continue
+    starts: Dict[str, float] = {}
+    for e in events:
+        if e.get("name") != "compute":
+            continue
+        parent = str((e.get("args") or {}).get("parent_id"))
+        if parent not in waves:
+            continue
+        try:
+            t0 = float(e["ts"]) / 1e6
+        except (TypeError, ValueError):
+            continue
+        starts[parent] = min(starts.get(parent, t0), t0)
+    return [(max(t0, starts.get(sid, t0)), t1)
+            for sid, (t0, t1) in waves.items()]
+
+
+def _overlap_and_critical_path(doc: Dict[str, Any],
+                               comms: Dict[str, Any]) -> Dict[str, Any]:
+    """Feeder effectiveness + critical path over the merged timeline:
+    which stage — upload, compute (device-busy), exchange (modeled),
+    readback, claim, blob write — accounts for the most wall time.
+    Pure interval arithmetic over an already-captured document
+    (obs/comms.overlap_fraction; no clocks are read).
+
+    Overlap is computed PER PROCESS TRACK and the worst fraction
+    reported: one process's busy device must not hide another
+    process's feeder-bound run — the span-plane twin of the
+    collector's MIN-merge rule for the overlap gauge."""
+    from .comms import _union_length, overlap_fraction
+
+    events = _events(doc)
+    uploads = _union_ivals(events, "upload")
+    busy = _busy_ivals(events)
+    stages: Dict[str, float] = {}
+    for stage, ivals in (("upload", uploads), ("compute", busy),
+                         ("readback", _union_ivals(events, "readback")),
+                         ("claim", _union_ivals(events, "claim")),
+                         ("write", _union_ivals(events, "write"))):
+        secs = _union_length(ivals)
+        if secs > 0:
+            stages[stage] = round(secs, 4)
+    modeled = comms.get("modeled_exchange_s")
+    if modeled:
+        stages["exchange_modeled"] = round(float(modeled), 4)
+    out: Dict[str, Any] = {"stages": stages}
+    window = _union_ivals(events, "device_run") or \
+        _union_ivals(events, "job")
+    if window:
+        out["window_s"] = round(_union_length(window), 4)
+    if stages:
+        out["bound"] = max(stages, key=stages.get)
+    if uploads or busy:
+        up_s = _union_length(uploads)
+        busy_s = _union_length(busy)
+        # per-process overlap: intersect each track's uploads with ITS
+        # OWN busy windows, then take the worst fraction among tracks
+        # that actually waited on uploads
+        pids = {e.get("pid") for e in events
+                if e.get("name") in ("upload", "wave")}
+        per_proc: Dict[Any, float] = {}
+        for pid in pids:
+            pe = [e for e in events if e.get("pid") == pid]
+            pup = _union_ivals(pe, "upload")
+            if _union_length(pup) <= 0.0:
+                continue
+            per_proc[pid] = overlap_fraction(pup, _busy_ivals(pe))
+        frac = min(per_proc.values()) if per_proc \
+            else overlap_fraction(uploads, busy)
+        out["upload_overlap_frac"] = round(frac, 4)
+        if len(per_proc) > 1:
+            out["upload_overlap_frac_by_proc"] = {
+                str(pid): round(f, 4)
+                for pid, f in sorted(per_proc.items())}
+        out["upload_s"] = round(up_s, 4)
+        out["device_busy_s"] = round(busy_s, 4)
+        # the same intersection seen from the compute side: how much of
+        # device execution had an upload hiding under it
+        out["overlap_of_compute_frac"] = (
+            round(frac * up_s / busy_s, 4) if busy_s > 0 else 0.0)
+        out["feeder_bound"] = bool(
+            frac < OVERLAP_FEEDER_BOUND_FRAC and uploads and busy
+            and up_s > 0.1 * max(busy_s, 1e-9))
+    return out
+
+
 # -- phase breakdown ---------------------------------------------------------
 
 _HOST_PHASES = ("claim", "run", "write")
@@ -404,6 +628,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
     offline on a captured file."""
     cluster = doc.get("mrtpuCluster") or {}
     stragglers, workers, latency_source = _find_stragglers(doc)
+    comms = _comms_findings(doc)
     report: Dict[str, Any] = {
         "aligned_to": cluster.get("aligned_to"),
         "n_procs": len(cluster.get("procs") or {}) or None,
@@ -416,10 +641,43 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "hotspots": _find_hotspots(doc, top_k),
         "compile_hotspots": _compile_hotspots(doc, top_k),
         "memory": _memory_findings(doc),
+        "comms": comms,
+        "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
         "trace_events": len(doc.get("traceEvents") or []),
     }
     notes: List[str] = []
+    for task, ex in sorted((comms.get("exchange") or {}).items()):
+        if ex["imbalance_recv"] >= EXCHANGE_IMBALANCE_NOTE_RATIO:
+            hot = ex["hot_dst"]
+            try:
+                hot = int(str(hot).lstrip("DP"))
+            except ValueError:
+                pass
+            notes.append(
+                "exchange imbalance {:.1f}x on task {}: device {} "
+                "receives {:.0%} of records".format(
+                    ex["imbalance_recv"], task, hot,
+                    ex["hot_dst_share"]))
+    cp = report["critical_path"]
+    if cp.get("feeder_bound"):
+        notes.append(
+            "upload overlapped {:.0%} of device compute — feeder-bound "
+            "(only {:.0%} of {:.3g}s upload waiting hid under "
+            "execution)".format(cp.get("overlap_of_compute_frac", 0.0),
+                                cp.get("upload_overlap_frac", 0.0),
+                                cp.get("upload_s", 0.0)))
+    if cp.get("bound"):
+        notes.append(
+            "critical path: {} dominates the timeline ({:.3g}s)".format(
+                cp["bound"], cp["stages"].get(cp["bound"], 0.0)))
+    skew_sources = {s.get("source") for s in report["skew"]
+                    if s.get("plane") == "device"}
+    if "exchange_matrix" in skew_sources:
+        notes.append(
+            "device skew derived from the exchange traffic matrix "
+            "(recv totals); partition gauges were absent from the "
+            "document")
     for r in report["memory"].get("capacity_retries") or []:
         pm = r.get("program_memory") or {}
         footprint = pm.get("total")
@@ -506,7 +764,9 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
                 "  [{plane}] task {task} partition {partition}: "
                 "{records} records = {share:.1%} of the task "
                 "({ratio_vs_uniform}x uniform over "
-                "{partitions_observed} partitions)".format(**s))
+                "{partitions_observed} partitions)".format(**s)
+                + (" [via exchange matrix]"
+                   if s.get("source") == "exchange_matrix" else ""))
     else:
         lines.append("partition skew: none detected")
 
@@ -519,6 +779,41 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
             lines.append(f"  {h['metric']}{{{lbl}}} = {h['value']:g}")
     else:
         lines.append("fault/retry hotspots: none")
+
+    comms = report.get("comms") or {}
+    ex_tasks = comms.get("exchange") or {}
+    if ex_tasks:
+        lines.append("exchange traffic:")
+        for t, ex in sorted(ex_tasks.items()):
+            lines.append(
+                "  task {}: {} records over {} device(s), recv "
+                "imbalance {:.2f}x (hot {} at {:.1%})".format(
+                    t, ex["records"], ex["devices_observed"],
+                    ex["imbalance_recv"], ex["hot_dst"],
+                    ex["hot_dst_share"]))
+        link = comms.get("bytes_by_link") or {}
+        if link:
+            lines.append("  bytes by link: " + "  ".join(
+                f"{cls} {v:,}" for cls, v in link.items()))
+        if comms.get("modeled_exchange_s") is not None:
+            lines.append(
+                "  modeled exchange {:.4g}s{} [analytic]".format(
+                    comms["modeled_exchange_s"],
+                    "" if comms.get("exchange_frac_of_compute") is None
+                    else " = {:.1%} of measured compute".format(
+                        comms["exchange_frac_of_compute"])))
+    cp = report.get("critical_path") or {}
+    if cp.get("stages"):
+        parts = "  ".join(f"{k} {v:.3g}s"
+                          for k, v in sorted(cp["stages"].items()))
+        lines.append(f"critical path: {parts} -> bound: "
+                     f"{cp.get('bound')}")
+        if cp.get("upload_overlap_frac") is not None:
+            lines.append(
+                "  upload overlap: {:.0%} of {:.3g}s upload hid under "
+                "device execution{}".format(
+                    cp["upload_overlap_frac"], cp.get("upload_s", 0.0),
+                    " (FEEDER-BOUND)" if cp.get("feeder_bound") else ""))
 
     comp = report.get("compile_hotspots") or []
     if comp:

@@ -142,6 +142,16 @@ DIAG_FAMILIES = frozenset({
     "mrtpu_device_memory_bytes",
     "mrtpu_device_donation_saved_bytes",
     "mrtpu_device_capacity_retry_events_total",
+    # the comms observability plane (obs/comms): the exchange traffic
+    # matrix, its link-class roll-up and the imbalance/overlap/roofline
+    # gauges all travel to /clusterz so diagnose sees who-sends-to-whom
+    # cluster-wide (the skew check's matrix fallback rides these rows)
+    "mrtpu_exchange_records_total", "mrtpu_exchange_bytes_total",
+    "mrtpu_comms_bytes_total",
+    "mrtpu_exchange_imbalance",
+    "mrtpu_comms_modeled_exchange_seconds",
+    "mrtpu_comms_exchange_frac_of_compute",
+    "mrtpu_upload_overlap_frac",
 })
 
 #: diagnosis gauges that must merge across processes by MAX, not sum:
@@ -153,6 +163,19 @@ DIAG_FAMILIES = frozenset({
 _DIAG_GAUGE_MAX = frozenset({
     "mrtpu_device_memory_bytes",
     "mrtpu_device_donation_saved_bytes",
+    # last-run gauges, not cluster-additive quantities: two processes'
+    # imbalance (or modeled seconds) must not sum into a fiction — the
+    # worst process's view is what diagnosis wants
+    "mrtpu_exchange_imbalance",
+    "mrtpu_comms_modeled_exchange_seconds",
+    "mrtpu_comms_exchange_frac_of_compute",
+})
+
+#: and gauges where the WORST view is the smallest value: an overlap
+#: fraction merged by max would let one healthy feeder hide another
+#: process's feeder-bound run
+_DIAG_GAUGE_MIN = frozenset({
+    "mrtpu_upload_overlap_frac",
 })
 
 
@@ -371,10 +394,15 @@ class Collector:
             for (name, labelkey), value in parsed.items():
                 if name not in DIAG_FAMILIES:
                     continue
-                prev = agg.get((name, labelkey), 0.0)
-                agg[(name, labelkey)] = (max(prev, value)
-                                         if name in _DIAG_GAUGE_MAX
-                                         else prev + value)
+                key = (name, labelkey)
+                if key not in agg:
+                    agg[key] = value
+                elif name in _DIAG_GAUGE_MAX:
+                    agg[key] = max(agg[key], value)
+                elif name in _DIAG_GAUGE_MIN:
+                    agg[key] = min(agg[key], value)
+                else:
+                    agg[key] = agg[key] + value
         return [[name, dict(labelkey), value]
                 for (name, labelkey), value in sorted(agg.items())]
 

@@ -363,7 +363,9 @@ def write_bundle(out_dir: str, store: Any = None,
             from .statusz import cluster_status
             statusz_doc = cluster_status(store)
         else:
-            from .statusz import compile_snapshot, memory_snapshot_section
+            from .statusz import (
+                comms_snapshot_section, compile_snapshot,
+                memory_snapshot_section)
 
             statusz_doc = {"tasks": {},
                            "device": device_snapshot(registry)}
@@ -373,6 +375,9 @@ def write_bundle(out_dir: str, store: Any = None,
             mem = memory_snapshot_section()
             if mem:
                 statusz_doc["memory"] = mem
+            comms_sec = comms_snapshot_section()
+            if comms_sec:
+                statusz_doc["comms"] = comms_sec
     if trace_doc is None:
         trace_doc = tracer.chrome_trace()
     validate_trace(trace_doc)
@@ -404,6 +409,22 @@ def write_bundle(out_dir: str, store: Any = None,
               encoding="utf-8") as f:
         json.dump(ledger_doc, f, indent=1, default=float)
     files.append("compile_ledger.json")
+    # the comms plane (obs/comms): the capturing process's exchange
+    # traffic matrix roll-ups + overlap fraction — strict-validated on
+    # write AND reload like everything else in the bundle.  Only
+    # written when an instrumented run happened here: an empty comms
+    # file would read as "the exchange sent nothing", which is a lie.
+    from .comms import comms_snapshot, validate_comms
+
+    comms_snap = comms_snapshot()
+    if comms_snap:
+        comms_doc = {"kind": "mrtpu-comms", "version": 1,
+                     "snapshot": comms_snap}
+        validate_comms(comms_doc)
+        with open(os.path.join(out_dir, "comms.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(comms_doc, f, indent=1, default=float)
+        files.append("comms.json")
     if cluster_doc is not None:
         from .analysis import diagnose
 
@@ -465,6 +486,14 @@ def load_bundle(path: str) -> Dict[str, Any]:
             ledger_doc = json.load(f)
         validate_compile_ledger(ledger_doc)
         out["compile_ledger"] = ledger_doc
+    comms_path = os.path.join(path, "comms.json")
+    if os.path.exists(comms_path):
+        from .comms import validate_comms
+
+        with open(comms_path, encoding="utf-8") as f:
+            comms_doc = json.load(f)
+        validate_comms(comms_doc)
+        out["comms"] = comms_doc
     cluster_path = os.path.join(path, "cluster_trace.json")
     if os.path.exists(cluster_path):
         with open(cluster_path, encoding="utf-8") as f:

@@ -161,6 +161,15 @@ def memory_snapshot_section() -> Dict[str, Any]:
     return memory_snapshot()
 
 
+def comms_snapshot_section() -> Dict[str, Any]:
+    """The comms section of /statusz (obs/comms last-sample mirror:
+    exchange traffic matrix roll-ups, link-class bytes, upload/compute
+    overlap fraction)."""
+    from .comms import comms_snapshot
+
+    return comms_snapshot()
+
+
 def cluster_status(store, now: Optional[float] = None,
                    collector=None) -> Dict[str, Any]:
     """The /statusz document: one entry per task database on the board,
@@ -187,6 +196,9 @@ def cluster_status(store, now: Optional[float] = None,
     mem = memory_snapshot_section()
     if mem:
         out["memory"] = mem
+    comms = comms_snapshot_section()
+    if comms:
+        out["comms"] = comms
     if collector is not None:
         out["telemetry"] = collector.summary()
     for db, colls in sorted(_dbnames(store).items()):

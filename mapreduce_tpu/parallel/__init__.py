@@ -6,7 +6,9 @@ program, over ICI — the design inversion BASELINE.json calls the north
 star ("replace polled shared state with compiled collectives").
 """
 
-from .mesh import make_mesh, data_axis_size  # noqa: F401
+from .mesh import (  # noqa: F401
+    LINK_CLASSES, data_axis_size, device_link_matrix, link_class,
+    link_peaks, make_mesh)
 from .shuffle import partition_exchange, Exchanged  # noqa: F401
 from .partition import (  # noqa: F401
     UnmatchedLeafError, match_partition_rules, shard_tree)

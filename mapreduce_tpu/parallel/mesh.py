@@ -16,7 +16,8 @@ collectives ride ICI.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -43,3 +44,85 @@ def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
 def data_axis_size(mesh: Mesh) -> int:
     """GLOBAL size of the data axis (spans all hosts on a multi-host mesh)."""
     return mesh.shape["data"]
+
+
+# -- topology model: link classes + per-class peak bandwidth ------------------
+#
+# The comms observability layer (obs/comms) rolls the exchange traffic
+# matrix up by the KIND of wire each (src, dst) device pair talks over.
+# Device objects carry enough identity to classify honestly:
+#
+#   * ``self`` — src is dst: the all_to_all's diagonal never leaves the
+#     chip (an HBM copy, not interconnect traffic);
+#   * ``ici``  — different chips inside one slice: the inter-chip
+#     interconnect (the wire the ROADMAP's 2-D mesh keeps the shuffle
+#     on);
+#   * ``dcn``  — chips in different slices (``slice_index`` differs):
+#     the data-center network between slices;
+#   * ``host`` — no accelerator interconnect at all (CPU devices, the
+#     tier-1 test mesh): bytes move through host memory.
+#
+# Like obs/profile's FLOPs peaks, the bandwidth numbers are datasheet-
+# order denominators for a roofline ratio, not measurements — the table
+# says so via ``peak_source`` and every figure derived from it is
+# labelled ``source="analytic"``.
+
+#: link classes, in locality order
+LINK_CLASSES: Tuple[str, ...] = ("self", "ici", "dcn", "host")
+
+#: default per-link-class peak bandwidth (bytes/s per device pair):
+#: self = HBM copy bandwidth order, ici = one v5e ICI link direction,
+#: dcn = ~100 Gb/s NIC share, host = host-memory/PCIe order.
+_DEFAULT_LINK_PEAKS: Dict[str, float] = {
+    "self": 819e9,   # on-chip: HBM bandwidth order (v5e datasheet)
+    "ici": 45e9,     # per-link ICI, one direction (v5e: ~1.6Tb/s over
+    #                  4 links -> ~45GB/s per link-direction)
+    "dcn": 12.5e9,   # 100 Gb/s data-center NIC
+    "host": 10e9,    # host-memory staging / PCIe order
+}
+
+#: env override names, checked by :func:`link_peaks`
+_LINK_PEAK_ENV = {
+    cls: f"MAPREDUCE_TPU_PEAK_{cls.upper()}_BYTES_PER_S"
+    for cls in LINK_CLASSES}
+
+
+def link_peaks() -> Dict[str, Any]:
+    """The per-link-class peak-bandwidth table (bytes/s), each class
+    individually overridable via ``MAPREDUCE_TPU_PEAK_<CLASS>_BYTES_PER_S``;
+    ``peak_source`` records which figures came from the environment so
+    the numbers stay honest about their provenance."""
+    out: Dict[str, Any] = {}
+    overridden: List[str] = []
+    for cls in LINK_CLASSES:
+        env = os.environ.get(_LINK_PEAK_ENV[cls])
+        if env:
+            out[cls] = float(env)
+            overridden.append(cls)
+        else:
+            out[cls] = _DEFAULT_LINK_PEAKS[cls]
+    out["peak_source"] = ("env:" + ",".join(overridden) if overridden
+                          else "datasheet")
+    return out
+
+
+def link_class(src: Any, dst: Any) -> str:
+    """Classify the wire between two devices (jax Device objects or
+    anything with ``id``/``platform``/``slice_index`` attrs) as
+    ``self`` / ``ici`` / ``dcn`` / ``host``."""
+    if src is dst or getattr(src, "id", None) == getattr(dst, "id", object()):
+        return "self"
+    platform = str(getattr(src, "platform", "") or "").lower()
+    if platform == "cpu":
+        return "host"  # no accelerator interconnect: host-memory copies
+    s_slice = getattr(src, "slice_index", None)
+    d_slice = getattr(dst, "slice_index", None)
+    if s_slice is not None and d_slice is not None and s_slice != d_slice:
+        return "dcn"
+    return "ici"
+
+
+def device_link_matrix(devices: Sequence[Any]) -> List[List[str]]:
+    """``[n, n]`` link-class names for every (src, dst) device pair, in
+    the data-axis order the exchange traffic matrix uses."""
+    return [[link_class(s, d) for d in devices] for s in devices]

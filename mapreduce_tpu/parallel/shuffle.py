@@ -31,6 +31,10 @@ class Exchanged(NamedTuple):
     overflow: jax.Array  # [] int32 — rows dropped on the SEND side here
     max_count: jax.Array  # [] int32 — largest per-destination row count
     #                       BEFORE capping (what capacity SHOULD have been)
+    counts: jax.Array    # [P] int32 — valid rows this device ROUTED to
+    #                       each destination, before capacity capping:
+    #                       THIS device's row of the src×dst exchange
+    #                       traffic matrix (obs/comms)
 
 
 def partition_exchange(keys: jax.Array, values: jax.Array,
@@ -102,4 +106,5 @@ def partition_exchange(keys: jax.Array, values: jax.Array,
         valid=out_valid,
         overflow=overflow,
         max_count=counts.max().astype(jnp.int32),
+        counts=counts.astype(jnp.int32),
     )

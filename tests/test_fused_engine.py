@@ -19,7 +19,10 @@ of them must be INVISIBLE in results:
   must converge to the same answer as generous ones;
 * the execution model itself: exactly one program dispatch per wave,
   zero merge dispatches, and the wave inputs + accumulator declared
-  buffer donors in the lowering.
+  buffer donors in the lowering;
+* exchange_stats (the obs/comms traffic matrix, a side lane of the
+  same program): enabling it never changes fold values bit-for-bit,
+  disabling it genuinely removes the lane.
 """
 
 import numpy as np
@@ -266,11 +269,12 @@ def test_one_dispatch_per_wave_no_merge_program(mesh):
 
 
 def test_wave_inputs_and_accumulator_are_buffer_donors(mesh):
-    """The lowered wave program must declare the wave inputs (args 0-1)
-    and the accumulator (args 3-6) donated — buffer_donor / aliasing
-    tags in the MLIR — while n_real (arg 2, reused every wave) stays
-    undonated.  Lowering-level, so it holds on backends whose runtime
-    keeps unaliased donations alive."""
+    """The lowered wave program must declare the wave inputs (args 0-1),
+    the accumulator (args 3-6) AND the exchange-traffic accumulator
+    (arg 7, rides by default) donated — buffer_donor / aliasing tags in
+    the MLIR — while n_real (arg 2, reused every wave) stays undonated.
+    Lowering-level, so it holds on backends whose runtime keeps
+    unaliased donations alive."""
     cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
                        out_capacity=256, reduce_op="sum")
     eng = DeviceEngine(mesh, _records_map_fn, cfg)
@@ -283,12 +287,60 @@ def test_wave_inputs_and_accumulator_are_buffer_donors(mesh):
         jax.ShapeDtypeStruct((), np.int32, sharding=rep),
     ) + tuple(
         jax.ShapeDtypeStruct((n_dev,) + a.shape, a.dtype, sharding=row_sh)
-        for a in eng._fin_row_avals(cfg, (32,), np.int32))
+        for a in eng._fin_row_avals(cfg, (32,), np.int32)) + (
+        jax.ShapeDtypeStruct((n_dev, n_dev), np.int32, sharding=row_sh),)
     txt = eng._get_compiled(cfg).lower(*shapes).as_text()
     head = next(line for line in txt.splitlines()
                 if "func.func public @main" in line)
     segs = head.split("%arg")[1:]
-    assert len(segs) == 7, head[:200]
+    assert len(segs) == 8, head[:200]
     donated = ["jax.buffer_donor = true" in s or "tf.aliasing_output" in s
                for s in segs]
-    assert donated == [True, True, False, True, True, True, True], donated
+    assert donated == [True, True, False, True, True, True, True,
+                       True], donated
+
+
+def test_exchange_stats_on_off_identical_folds(mesh):
+    """The golden bit-identity pin for EngineConfig.exchange_stats: the
+    traffic-matrix lane is a pure side output of the SAME fused program
+    — enabling it must never change a fold value, bit for bit, across
+    a multi-wave run of every integer monoid the fold suite covers."""
+    rng = np.random.default_rng(23)
+    chunks = _chunks(rng, 3 * mesh.shape["data"] * 2)
+    for op in ("sum", "min", "max"):
+        results = []
+        for stats in (True, False):
+            cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                               out_capacity=256, reduce_op=op,
+                               exchange_stats=stats)
+            res = DeviceEngine(mesh, _records_map_fn, cfg).run(
+                chunks, waves=3, max_retries=0)
+            assert res.overflow == 0
+            results.append(res)
+        on, off = results
+        for field in ("keys", "values", "payload", "valid"):
+            a, b = np.asarray(getattr(on, field)), \
+                np.asarray(getattr(off, field))
+            assert np.array_equal(a, b), (op, field)
+        assert _result_dict(on) == _dict_oracle(chunks, op)
+
+
+def test_exchange_stats_off_disables_matrix(mesh):
+    """exchange_stats=False must genuinely gate the plane off: no
+    matrix keys in timings and no exchange counters incremented."""
+    from mapreduce_tpu.obs.metrics import REGISTRY
+
+    rng = np.random.default_rng(29)
+    chunks = _chunks(rng, 2 * mesh.shape["data"])
+    cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                       out_capacity=256, reduce_op="sum",
+                       exchange_stats=False)
+    e0 = REGISTRY.sum("mrtpu_exchange_records_total")
+    tm = {}
+    res = DeviceEngine(mesh, _records_map_fn, cfg).run(
+        chunks, timings=tm, waves=2, max_retries=0)
+    assert res.overflow == 0
+    assert REGISTRY.sum("mrtpu_exchange_records_total") == e0
+    assert "exchange_records" not in tm and "exchange" not in tm
+    # the overlap fraction is span-derived, not matrix-derived: still on
+    assert 0.0 <= tm["upload_overlap_frac"] <= 1.0

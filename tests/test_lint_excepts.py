@@ -177,6 +177,11 @@ _MONOTONIC_ONLY_MODULES = {
     # the forensics timeline alike
     os.path.join("mapreduce_tpu", "obs", "compile.py"),
     os.path.join("mapreduce_tpu", "obs", "memory.py"),
+    # the comms observability plane: the traffic matrix and overlap
+    # fraction are derived FROM monotonic span intervals — comms.py
+    # reads no clocks at all, and this lint pins that a future edit
+    # cannot quietly add a steppable one to the overlap arithmetic
+    os.path.join("mapreduce_tpu", "obs", "comms.py"),
     # the elastic training plane: fit()'s recovery gauge and the
     # checkpoint layer feed gated bench numbers (trainer_recovery_s)
     # and step-recovery telemetry — duration math only, so the whole
