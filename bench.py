@@ -100,6 +100,15 @@ def gate_specs():
         MetricSpec("exchange_imbalance", rel_tol=0.50, required=True),
         MetricSpec("upload_overlap_frac", rel_tol=0.90,
                    direction="higher", required=True),
+        # the always-on service plane (sched/ + engine/session):
+        # records/s a resident EngineSession sustains while tenants are
+        # submitted/cancelled on a live scheduler mid-stream
+        # (measure_sustained).  Higher is better, REQUIRED, and the
+        # tolerance is WIDE (allow down to 10% of the median) because
+        # the history mixes platforms — the first seeded entry is a CPU
+        # measurement and a real TPU raises the bar as it appends.
+        MetricSpec("sustained_records_per_s", rel_tol=0.90,
+                   direction="higher", required=True),
     ]
 VOCAB = 80_000
 N_PUNCT_VOCAB = 10_000       # vocab entries that are word+punctuation
@@ -277,6 +286,140 @@ def measure_cold_warm(smoke: bool) -> dict:
     }
 
 
+def measure_sustained(mesh, smoke: bool) -> dict:
+    """Sustained-throughput under tenant churn (the always-on service
+    mode): a resident :class:`EngineSession` serves several tenant
+    streams over ONE mesh while a churn thread submits and cancels
+    scheduler tasks mid-stream, and the reported number is records/s
+    folded into the resident aggregates over the feed loop's wall time
+    (records = word occurrences, exact from the unit-count snapshots).
+
+    Pre-chunked inputs and a pre-warmed program keep the number the
+    SERVING rate (upload + fused dispatch + overflow readback), not a
+    text-splitting or compile benchmark — matching the main bench's
+    clock semantics (corpus staged, compile excluded)."""
+    import threading
+
+    import jax  # noqa: F401  (the session dispatches engine programs)
+
+    from mapreduce_tpu.coord.docstore import MemoryDocStore
+    from mapreduce_tpu.engine.device_engine import EngineConfig
+    from mapreduce_tpu.engine.session import EngineSession
+    from mapreduce_tpu.engine.topk import TopKWords
+    from mapreduce_tpu.engine.wordcount import wordcount_map_fn
+    from mapreduce_tpu.ops.tokenize import shard_text
+    from mapreduce_tpu.sched.scheduler import (
+        Scheduler, SchedulerConfig)
+
+    if smoke:
+        chunk_len, rounds, slice_words = 4096, 3, 6_000
+        # combine_capacity explicit: a session stream cannot
+        # capacity-retry, so the per-chunk combiner slots must cover a
+        # dense Zipf chunk up front (T = L/tile*tile_records = 1152)
+        cfg = EngineConfig(local_capacity=8192, exchange_capacity=4096,
+                           out_capacity=16384, tile=512,
+                           tile_records=128, combine_in_scan=True,
+                           combine_capacity=2048,
+                           unit_values=True, reduce_op="sum")
+    else:
+        chunk_len, rounds, slice_words = 1 << 20, 3, 1_500_000
+        cfg = EngineConfig(local_capacity=1 << 17,
+                           exchange_capacity=1 << 15,
+                           out_capacity=1 << 17, tile=512,
+                           tile_records=104, combine_in_scan=True,
+                           combine_capacity=1 << 17,
+                           unit_values=True, reduce_op="sum")
+    tenants = ["t0", "t1", "t2"]
+    scheduler = Scheduler(MemoryDocStore(),
+                          config=SchedulerConfig(
+                              max_inflight=len(tenants) + 1))
+    for t in tenants:
+        scheduler.submit(t, db=f"sess_{t}", kind="session",
+                         est_jobs=rounds)
+    scheduler.tick()
+
+    # one corpus slice, pre-chunked; every (tenant, round) feeds a copy
+    # (streams accumulate counts, so re-feeding the same block is a
+    # legitimate — and deterministic — sustained load)
+    corpus = make_corpus(slice_words, max(slice_words // 25, 1))
+    n_chunks = max(1, -(-len(corpus) // chunk_len))
+    chunks, _L = shard_text(corpus, n_chunks, pad_multiple=cfg.tile,
+                            pad_to=chunk_len + cfg.tile)
+    # k passed EXPLICITLY, sized from the FULL per-feed chunk count:
+    # letting the small warm feed latch it would pin minimum-size waves
+    # (k=1) and depress the gated rate with per-wave dispatch overhead
+    session = EngineSession(mesh, wordcount_map_fn, cfg, task="sustained")
+    eng = session.engine
+    row_bytes = max(1, chunks.nbytes // len(chunks))
+    session.k = max(1, min(eng._rows_per_wave(row_bytes),
+                           -(-len(chunks) // eng.n_dev)))
+    session.feed(chunks[: min(len(chunks),
+                              session.engine.n_dev)], task="warm")
+    session.close("warm")  # program compiled; drop the warm stream
+
+    churn_stop = threading.Event()
+    churn_counts = {"submitted": 0, "cancelled": 0}
+
+    def _churn():
+        i = 0
+        while not churn_stop.is_set():
+            doc = scheduler.submit("churn", db=f"churn_{i}",
+                                   kind="session", est_jobs=1)
+            scheduler.tick()
+            churn_counts["submitted"] += 1
+            if scheduler.cancel(doc["_id"]) is not None:
+                churn_counts["cancelled"] += 1
+            i += 1
+            churn_stop.wait(0.02)
+
+    churn_t = threading.Thread(target=_churn, daemon=True)
+    churn_t.start()
+    t0 = time.monotonic()
+    for _r in range(rounds):
+        for t in tenants:
+            session.feed(chunks, task=t)
+    wall = time.monotonic() - t0
+    churn_stop.set()
+    churn_t.join(timeout=5)
+
+    records = 0
+    waves = 0
+    for t in tenants:
+        snap = session.snapshot(t)
+        assert snap.overflow == 0, (
+            f"sustained stream {t} overflowed {snap.overflow} rows — "
+            "size the config up, the number would be a lie")
+        vals = np.asarray(snap.values).reshape(-1)
+        valid = np.asarray(snap.valid).reshape(-1)
+        n = int(vals[valid.nonzero()[0]].sum())
+        records += n
+        waves += session.stats(t)["waves"]
+        scheduler.note_served(t, n)
+
+    # the top-K bench entry: a streaming TopKWords over one slice, the
+    # mid-stream snapshot+selection timed (the bounded-output read the
+    # workload exists for)
+    tk = TopKWords(mesh, k=20, chunk_len=chunk_len, config=cfg)
+    tk.feed(corpus)
+    t1 = time.monotonic()
+    top = tk.topk()
+    topk_s = time.monotonic() - t1
+    session.close()
+
+    return {
+        "sustained_records_per_s": round(records / max(wall, 1e-9), 1),
+        "sustained_records": records,
+        "sustained_wall_s": round(wall, 4),
+        "sustained_tenants": len(tenants),
+        "sustained_rounds": rounds,
+        "sustained_waves": waves,
+        "sustained_churn_submitted": churn_counts["submitted"],
+        "sustained_churn_cancelled": churn_counts["cancelled"],
+        "topk_k": len(top),
+        "topk_snapshot_s": round(topk_s, 4),
+    }
+
+
 def check_smoke() -> int:
     """``--check --smoke``: the tier-1-safe regression-gate self-check.
     No accelerator requirement and ZERO wall-clock comparisons (so it
@@ -420,6 +563,59 @@ def check_smoke() -> int:
         f"({new_obs} new backend_compile observation(s)) — the "
         "executable cache is not serving it")
 
+    # always-on-service gate (registry-only): the sustained mode runs
+    # with the SESSION layer active — the fused execution model must
+    # hold there too (exactly one wave-program dispatch per session
+    # wave, zero merge dispatches), the new gated key must be present
+    # and seeded in history, and a session snapshot must agree with a
+    # from-scratch batch count of the same bytes.
+    sd0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    sw0 = REGISTRY.sum("mrtpu_session_waves_total")
+    sustained = measure_sustained(make_mesh(), smoke=True)
+    sess_waves = REGISTRY.sum("mrtpu_session_waves_total") - sw0
+    sess_disp = (REGISTRY.sum("mrtpu_device_dispatches_total",
+                              program="wave") - sd0)
+    assert sess_waves > 0 and sess_disp == sess_waves, (
+        f"session layer dispatched {sess_disp} programs for "
+        f"{sess_waves} session waves (expected exactly one per wave)")
+    assert REGISTRY.sum("mrtpu_device_dispatches_total",
+                        program="merge") == 0
+    assert sustained["sustained_records_per_s"] > 0, sustained
+    assert sustained["sustained_churn_submitted"] > 0, (
+        "churn thread never ran — the 'under tenant churn' claim "
+        "would be vacuous")
+    assert benchgate.lookup(sustained, "sustained_records_per_s") \
+        is not None
+    assert any(benchgate.lookup(h, "sustained_records_per_s") is not None
+               for h in history), (
+        "no BENCH.json history entry carries 'sustained_records_per_s'")
+
+    from mapreduce_tpu.engine.session import EngineSession
+    from mapreduce_tpu.engine.wordcount import wordcount_map_fn
+
+    sess = EngineSession(
+        make_mesh(), wordcount_map_fn,
+        EngineConfig(local_capacity=4096, exchange_capacity=2048,
+                     out_capacity=4096, tile=512, tile_records=128,
+                     combine_in_scan=True, unit_values=True,
+                     reduce_op="sum"),
+        task="smoke-session")
+    from mapreduce_tpu.ops.tokenize import shard_text
+
+    sm_chunks, _L = shard_text(corpus, max(1, len(corpus) // 4096),
+                               pad_multiple=512, pad_to=4096 + 512)
+    half = max(1, len(sm_chunks) // 2)
+    sess.feed(sm_chunks[:half])
+    sess.feed(sm_chunks[half:])
+    snap = sess.snapshot()
+    svals = np.asarray(snap.values).reshape(-1)
+    svalid = np.asarray(snap.valid).reshape(-1)
+    session_total = int(svals[svalid.nonzero()[0]].sum())
+    assert session_total == sum(counts.values()), (
+        f"session aggregate {session_total} != batch word total "
+        f"{sum(counts.values())}")
+    sess.close()
+
     # collector overhead gate: telemetry for the whole engine run must
     # fit a bounded number of push batches (the pusher batches the span
     # ring, it does not chat per span/wave), lose NOTHING in a
@@ -469,6 +665,8 @@ def check_smoke() -> int:
         "device_flops_recorded": flops,
         "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
         "second_build_cached": cached_delta,
+        "sustained_records_per_s": sustained["sustained_records_per_s"],
+        "session_dispatches_per_wave": sess_disp / sess_waves,
         "exchange_records": tm["exchange_records"],
         "exchange_imbalance": tm["exchange_imbalance"],
         "upload_overlap_frac": tm["upload_overlap_frac"],
@@ -640,6 +838,19 @@ def main() -> None:
           f"(warm wave outcome: {coldwarm['warm_outcome']})",
           file=sys.stderr, flush=True)
 
+    # the always-on service mode (sched/ + engine/session): sustained
+    # records/s while tenants churn on a live scheduler mid-stream
+    print("# measuring sustained throughput under tenant churn "
+          "(resident session, 3 tenants + churn) ...",
+          file=sys.stderr, flush=True)
+    sustained = measure_sustained(mesh, smoke="--smoke" in sys.argv)
+    print(f"# sustained_records_per_s="
+          f"{sustained['sustained_records_per_s']} over "
+          f"{sustained['sustained_waves']} waves, churn "
+          f"{sustained['sustained_churn_submitted']} submits / "
+          f"{sustained['sustained_churn_cancelled']} cancels",
+          file=sys.stderr, flush=True)
+
     result = {
         "metric": "europarl_wordcount_wall_s",
         "value": round(wall, 4),
@@ -678,6 +889,9 @@ def main() -> None:
         "upload_overlap_frac": best.get("upload_overlap_frac"),
         "exchange_records": best.get("exchange_records"),
         "modeled_exchange_s": best.get("modeled_exchange_s"),
+        # the gated always-on-service key (+ its context and the top-K
+        # workload's bench entry), from measure_sustained
+        **sustained,
     }
     print(json.dumps(result))
     print(f"# {len(counts)} unique words, {total} total; "

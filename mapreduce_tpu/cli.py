@@ -23,6 +23,16 @@ strips ``.lua``):
   python -m mapreduce_tpu.cli diagnose CONNSTR — straggler / partition-
       skew / fault-hotspot / phase-breakdown report over the merged
       timeline (obs/analysis).
+  python -m mapreduce_tpu.cli submit CONNSTR TENANT TASKFN MAPFN \
+      PARTITIONFN REDUCEFN [FINALFN] [STORAGE] — queue a task on the
+      docserver's multi-tenant scheduler (/tasks; admission-controlled,
+      weighted-fair dequeue; see README "Always-on service").
+  python -m mapreduce_tpu.cli tasks CONNSTR [--cancel ID] — list the
+      scheduler's tenant queues / cancel a task (a cancelled task's
+      queued jobs never run).
+  python -m mapreduce_tpu.cli runner CONNSTR [--workers N] — the
+      always-on serving process: lease-fenced admission + task drivers
+      + one cross-tenant worker pool.
   python -m mapreduce_tpu.cli train CONNSTR DB [--storage DSL] —
       elastic, preemption-tolerant training: trainer lease through the
       job board, sharded checkpoints through the blob plane,
@@ -579,6 +589,15 @@ def cmd_docserver(argv: List[str]) -> int:
     p.add_argument("--root", default=None,
                    help="back the board with dir://ROOT (durable) "
                         "instead of in-memory")
+    g = p.add_argument_group(
+        "scheduler admission (the /tasks surface this board hosts; "
+        "match --max-inflight on the runner — submits are quota-"
+        "checked HERE, admission by whichever process holds the lease)")
+    g.add_argument("--max-inflight", type=int, default=None,
+                   help="tasks admitted+running at once (default 2)")
+    g.add_argument("--tenant-max-queued-tasks", type=int, default=None)
+    g.add_argument("--tenant-max-queued-jobs", type=int, default=None)
+    g.add_argument("--tenant-max-queued-bytes", type=int, default=None)
     _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
@@ -586,9 +605,18 @@ def cmd_docserver(argv: List[str]) -> int:
 
     from .coord.docserver import DocServer
     from .coord.docstore import DirDocStore
+    from .sched.scheduler import SchedulerConfig
 
+    overrides = {k: v for k, v in (
+        ("max_inflight", args.max_inflight),
+        ("tenant_max_queued_tasks", args.tenant_max_queued_tasks),
+        ("tenant_max_queued_jobs", args.tenant_max_queued_jobs),
+        ("tenant_max_queued_bytes", args.tenant_max_queued_bytes),
+    ) if v is not None}
     store = DirDocStore(args.root) if args.root else None
-    srv = DocServer(store, args.host, args.port, auth_token=args.auth)
+    srv = DocServer(store, args.host, args.port, auth_token=args.auth,
+                    scheduler_config=(SchedulerConfig(**overrides)
+                                      if overrides else None))
     print(f"job board at http://{srv.host}:{srv.port} "
           f"(CONNSTR: \"http://HOST:{srv.port}\"; Prometheus at "
           f"/metrics, cluster snapshot at /statusz, merged cluster "
@@ -748,6 +776,33 @@ def _render_comms(comms: dict) -> List[str]:
     return lines
 
 
+def _render_sched(sched: dict) -> List[str]:
+    """The multi-tenant scheduler section of /statusz (sched/): queue
+    depth + declared queued work + served records per tenant, the
+    in-flight count against the admission budget, the lease holder."""
+    if not sched or not sched.get("tenants"):
+        return []
+    cfg = sched.get("config") or {}
+    lines = ["scheduler: {} in-flight of {} max".format(
+        sched.get("inflight", 0), cfg.get("max_inflight", "?"))]
+    lease = sched.get("lease")
+    if lease and lease.get("holder"):
+        lines[0] += "  (admission lease: {} gen {})".format(
+            lease["holder"], lease.get("generation", 0))
+    for t, row in sorted(sched["tenants"].items()):
+        active = " ".join(
+            f"{s}={row.get(s, 0)}"
+            for s in ("queued", "admitted", "running", "done",
+                      "cancelled", "failed") if row.get(s))
+        lines.append(
+            "  tenant {}: {}  | queued work {} jobs / {} B | "
+            "{} records served".format(
+                t, active or "idle", row.get("queued_jobs", 0),
+                row.get("queued_bytes", 0),
+                row.get("served_records", 0)))
+    return lines
+
+
 def _render_build(build: dict) -> List[str]:
     if not build:
         return []
@@ -813,6 +868,7 @@ def render_status(snap: dict) -> str:
     lines += _render_memory(snap.get("memory") or {})
     lines += _render_comms(snap.get("comms") or {})
     lines += _render_checkpoint(snap.get("checkpoint") or {})
+    lines += _render_sched(snap.get("sched") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
     tasks = snap.get("tasks", {})
     if not tasks:
@@ -1146,6 +1202,207 @@ def cmd_diagnose(argv: List[str]) -> int:
     return 0
 
 
+def _sched_client(connstr: str, auth, what: str):
+    """HOST:PORT normalisation + SchedulerClient construction for the
+    /tasks commands."""
+    from .sched.scheduler import SchedulerClient
+
+    addr = connstr
+    if addr.startswith("http://"):
+        addr = addr[len("http://"):]
+    addr = addr.split("/", 1)[0]
+    try:
+        return SchedulerClient(addr, auth_token=auth)
+    except ValueError:
+        print(f"{what} wants a docserver address (http://HOST:PORT), "
+              f"got {connstr!r}", file=sys.stderr)
+        return None
+
+
+def cmd_submit(argv: List[str]) -> int:
+    """Submit one task to a docserver's multi-tenant scheduler
+    (``/tasks`` surface, sched/scheduler.py): the task queues under the
+    tenant's quota, the lease-holding runner admits it weighted-fair
+    and drives it through the ordinary Server machinery.  Module
+    arguments mirror ``cli server`` — they are stored in the task doc
+    and resolved by the runner process."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu submit")
+    p.add_argument("connstr", help="the docserver, http://HOST:PORT")
+    p.add_argument("tenant")
+    p.add_argument("taskfn")
+    p.add_argument("mapfn")
+    p.add_argument("partitionfn")
+    p.add_argument("reducefn")
+    p.add_argument("finalfn", nargs="?", default=None)
+    p.add_argument("storage", nargs="?", default=None)
+    p.add_argument("--db", default=None,
+                   help="task database on the board (default: "
+                        "auto-generated; an ACTIVE db is refused — one "
+                        "Server per db)")
+    p.add_argument("--priority", type=int, default=0,
+                   help="within-tenant dequeue priority (higher first)")
+    p.add_argument("--weight", type=float, default=1.0,
+                   help="tenant fair-share weight")
+    p.add_argument("--est-jobs", type=int, default=0,
+                   help="declared job count (quota + fair-share charge)")
+    p.add_argument("--est-bytes", type=int, default=0,
+                   help="declared input bytes (quota accounting)")
+    p.add_argument("--init-args", default=None,
+                   help="JSON passed to every module init()")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    params = {
+        "taskfn": normalize_module(args.taskfn),
+        "mapfn": normalize_module(args.mapfn),
+        "partitionfn": normalize_module(args.partitionfn),
+        "reducefn": normalize_module(args.reducefn),
+        "finalfn": normalize_module(args.finalfn or args.reducefn),
+        "storage": args.storage,
+    }
+    if args.init_args:
+        params["init_args"] = json.loads(args.init_args)
+    client = _sched_client(args.connstr, args.auth, "submit")
+    if client is None:
+        return 2
+    from .sched.scheduler import QuotaExceededError
+
+    try:
+        doc = client.submit(args.tenant, db=args.db, params=params,
+                            priority=args.priority, weight=args.weight,
+                            est_jobs=args.est_jobs,
+                            est_bytes=args.est_bytes)
+    except QuotaExceededError as exc:
+        print(f"REJECTED ({exc.reason}): {exc}", file=sys.stderr)
+        return 3
+    except PermissionError as exc:
+        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"cannot reach {args.connstr}: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    print(json.dumps(doc, default=float))
+    return 0
+
+
+def cmd_tasks(argv: List[str]) -> int:
+    """List the scheduler's tasks and tenant queues (GET /tasks) or
+    cancel one (``--cancel ID``: a cancelled task's queued jobs never
+    run — its db is forced FINISHED and claimable jobs are removed)."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu tasks")
+    p.add_argument("connstr", help="the docserver, http://HOST:PORT")
+    p.add_argument("--cancel", default=None, metavar="TASK_ID")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    client = _sched_client(args.connstr, args.auth, "tasks")
+    if client is None:
+        return 2
+    try:
+        if args.cancel:
+            doc = client.cancel(args.cancel)
+            if doc is None:
+                print(f"task {args.cancel!r} not found or already "
+                      "terminal", file=sys.stderr)
+                return 1
+            print(json.dumps(doc, default=float))
+            return 0
+        listing = client.list()
+    except PermissionError as exc:
+        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"cannot reach {args.connstr}: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    if args.as_json:
+        print(json.dumps(listing, indent=2, default=float))
+        return 0
+    for line in _render_sched(listing.get("sched") or {}):
+        print(line)
+    for t in listing.get("tasks") or []:
+        print("  {:<9} {}  tenant={} db={} prio={} est_jobs={}".format(
+            t.get("state"), t.get("_id"), t.get("tenant"), t.get("db"),
+            t.get("priority", 0), t.get("est_jobs", 0)))
+    if not listing.get("tasks"):
+        print("no tasks submitted to this scheduler")
+    return 0
+
+
+def cmd_runner(argv: List[str]) -> int:
+    """The always-on serving process: a lease-fenced TaskRunner (ticks
+    admission, drives every admitted task through Server.loop) plus a
+    pool of cross-tenant workers claiming over every admitted task's
+    board (sched/service.py).  Point it at the same CONNSTR the
+    docserver serves; submit work with ``cli submit``."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu runner")
+    p.add_argument("connstr",
+                   help="the job board (http://HOST:PORT docserver, or "
+                        "mem://NAME / dir:///PATH for in-process use)")
+    p.add_argument("--workers", type=int, default=4,
+                   help="cross-tenant worker threads in this process")
+    p.add_argument("--max-inflight", type=int, default=2,
+                   help="tasks admitted+running at once")
+    p.add_argument("--job-lease", type=float, default=None, metavar="S")
+    _add_auth(p)
+    _add_retry(p)
+    _add_compile_cache(p)
+    _add_trace(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+    rec = _setup_trace(args)
+    _setup_compile_cache(args)
+
+    from .coord import docstore
+    from .sched.scheduler import Scheduler, SchedulerConfig
+    from .sched.service import TaskRunner, spawn_scheduled_workers
+
+    retry = _retry_policy(args)
+    store = docstore.connect(args.connstr, auth=args.auth, retry=retry)
+    scheduler = Scheduler(
+        store, config=SchedulerConfig(max_inflight=args.max_inflight))
+    runner = TaskRunner(args.connstr, scheduler, auth=args.auth,
+                        retry=retry, job_lease=args.job_lease).start()
+    pool = spawn_scheduled_workers(args.connstr, args.workers,
+                                   auth=args.auth, retry=retry,
+                                   job_lease=args.job_lease)
+    print(f"runner serving {args.connstr}: admission + {args.workers} "
+          "cross-tenant worker(s); submit with `cli submit`", flush=True)
+    rc = 0
+    try:
+        # a runner (or any pool worker) that stopped itself — auth
+        # rejected by the board — must exit with the diagnosis, not
+        # idle as a zombie advertising workers it no longer has
+        while not runner._stop.wait(1.0):
+            if any(w.failed is not None for w in pool):
+                break
+        failure = runner.failed or next(
+            (w.failed for w in pool if w.failed is not None), None)
+        if failure is not None:
+            print(f"{failure} (pass --auth or set "
+                  "$MAPREDUCE_TPU_AUTH)", file=sys.stderr)
+            rc = 2
+    except KeyboardInterrupt:
+        pass
+    finally:
+        runner.stop()
+        for w in pool:
+            w.stop()
+    _export_trace(args, rec)
+    return rc
+
+
 def cmd_warmup(argv: List[str]) -> int:
     """Prime the persistent XLA compilation cache for the device engine
     (cold compile is ~100s at bench shapes — the lax.sort comparator;
@@ -1223,7 +1480,9 @@ COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "blobserver": cmd_blobserver, "docserver": cmd_docserver,
             "warmup": cmd_warmup, "status": cmd_status,
             "profile": cmd_profile, "timeline": cmd_timeline,
-            "diagnose": cmd_diagnose, "train": cmd_train}
+            "diagnose": cmd_diagnose, "train": cmd_train,
+            "submit": cmd_submit, "tasks": cmd_tasks,
+            "runner": cmd_runner}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

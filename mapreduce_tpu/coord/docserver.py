@@ -106,6 +106,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     dedupe_lock: threading.Lock
     auth_token: Optional[str]  # None = open server
     collector: Collector       # cluster telemetry sink (obs/collector)
+    scheduler: Any             # sched.Scheduler hosted on self.store
 
     def log_message(self, *a):  # quiet
         pass
@@ -121,6 +122,8 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         if self.path == "/telemetry":
             return self._do_telemetry()
+        if self.path == "/tasks":
+            return self._do_tasks()
         if self.path != "/rpc":
             return self._respond(404, b"{}")
         length = int(self.headers.get("Content-Length", 0))
@@ -142,53 +145,9 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
 
         rid = req.get("rid") if op in _MUTATING_OPS else None
         if rid is not None:
-            # a retry can arrive while the original is STILL executing (the
-            # client only retries after its socket broke, but the server
-            # thread serving the broken socket may not have finished):
-            # reserve the rid before executing so the duplicate waits for
-            # the recorded response instead of re-applying
-            with self.dedupe_lock:
-                replay = self.done.get(rid)
-                waiter = None if replay is not None else self.inflight.get(rid)
-                stale = False
-                if replay is None and waiter is None:
-                    session, seq = _rid_session_seq(rid)
-                    if (session is not None and seq is not None
-                            and seq <= self.evicted.get(session, -1)):
-                        # straggling retry of an EVICTED entry: the answer
-                        # is gone, so whether the original applied is
-                        # unknowable — refuse loudly, never re-apply
-                        stale = True
-                    else:
-                        self.inflight[rid] = threading.Event()
-            if stale:
-                _DEDUPE_EVICTED.inc()
-                _REQUESTS.inc(op=op, outcome="evicted")
-                return self._respond(200, json.dumps(
-                    {"ok": False, "type": "DedupeEvictedError",
-                     "error": f"rid {rid}: retry arrived after its dedupe "
-                              "entry was evicted; cannot guarantee "
-                              "exactly-once"}).encode())
-            if replay is not None:
-                _DEDUPE_HITS.inc()
-                _REQUESTS.inc(op=op, outcome="replayed")
-                return self._respond(200, replay)
-            if waiter is not None:
-                waiter.wait(timeout=60)
-                with self.dedupe_lock:
-                    replay = self.done.get(rid)
-                if replay is None:  # original died without recording
-                    replay = json.dumps(
-                        {"ok": False, "type": "IOError",
-                         "error": "retried rpc: original did not complete"}
-                    ).encode()
-                    # NOT a dedupe hit: the cache had no answer — a
-                    # wedged original must show as an error, not a replay
-                    _REQUESTS.inc(op=op, outcome="error")
-                else:
-                    _DEDUPE_HITS.inc()
-                    _REQUESTS.inc(op=op, outcome="replayed")
-                return self._respond(200, replay)
+            answered = self._claim_rid(rid, op)
+            if answered is not None:
+                return self._respond(200, answered)
 
         body = None
         t_exec = time.monotonic()
@@ -211,25 +170,151 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         finally:
             _RPC_SECONDS.observe(time.monotonic() - t_exec, op=op)
             if rid is not None:
-                with self.dedupe_lock:
-                    ev = self.inflight.pop(rid, None)
-                    if body is not None:  # BaseException: leave unrecorded
-                        self.done[rid] = body
-                        while len(self.done) > _DEDUPE_CAP:
-                            old_rid, _ = self.done.popitem(last=False)
-                            # remember the high-water mark of evicted seqs
-                            # per session so a straggler can be refused
-                            # instead of re-applied (seqs are monotonic
-                            # per session, so max == newest evicted)
-                            s, q = _rid_session_seq(old_rid)
-                            if s is not None and q is not None:
-                                self.evicted[s] = max(
-                                    q, self.evicted.get(s, -1))
-                                self.evicted.move_to_end(s)
-                                while len(self.evicted) > _SESSION_CAP:
-                                    self.evicted.popitem(last=False)
-                if ev is not None:
-                    ev.set()
+                self._record_rid(rid, body)
+        self._respond(200, body)
+
+    # -- rid dedupe (shared by /rpc and /tasks mutations) -------------------
+
+    def _claim_rid(self, rid: str, op: str) -> Optional[bytes]:
+        """Reserve *rid* for execution, or return the bytes to answer a
+        duplicate with.  None means the caller executes and MUST call
+        :meth:`_record_rid` (its finally block) so waiters resolve.
+
+        A retry can arrive while the original is STILL executing (the
+        client only retries after its socket broke, but the server
+        thread serving the broken socket may not have finished):
+        reserving the rid before executing makes the duplicate wait for
+        the recorded response instead of re-applying."""
+        with self.dedupe_lock:
+            replay = self.done.get(rid)
+            waiter = None if replay is not None else self.inflight.get(rid)
+            stale = False
+            if replay is None and waiter is None:
+                session, seq = _rid_session_seq(rid)
+                if (session is not None and seq is not None
+                        and seq <= self.evicted.get(session, -1)):
+                    # straggling retry of an EVICTED entry: the answer
+                    # is gone, so whether the original applied is
+                    # unknowable — refuse loudly, never re-apply
+                    stale = True
+                else:
+                    self.inflight[rid] = threading.Event()
+        if stale:
+            _DEDUPE_EVICTED.inc()
+            _REQUESTS.inc(op=op, outcome="evicted")
+            return json.dumps(
+                {"ok": False, "type": "DedupeEvictedError",
+                 "error": f"rid {rid}: retry arrived after its dedupe "
+                          "entry was evicted; cannot guarantee "
+                          "exactly-once"}).encode()
+        if replay is not None:
+            _DEDUPE_HITS.inc()
+            _REQUESTS.inc(op=op, outcome="replayed")
+            return replay
+        if waiter is not None:
+            waiter.wait(timeout=60)
+            with self.dedupe_lock:
+                replay = self.done.get(rid)
+            if replay is None:  # original died without recording
+                replay = json.dumps(
+                    {"ok": False, "type": "IOError",
+                     "error": "retried rpc: original did not complete"}
+                ).encode()
+                # NOT a dedupe hit: the cache had no answer — a
+                # wedged original must show as an error, not a replay
+                _REQUESTS.inc(op=op, outcome="error")
+            else:
+                _DEDUPE_HITS.inc()
+                _REQUESTS.inc(op=op, outcome="replayed")
+            return replay
+        return None
+
+    def _record_rid(self, rid: str, body: Optional[bytes]) -> None:
+        with self.dedupe_lock:
+            ev = self.inflight.pop(rid, None)
+            if body is not None:  # BaseException: leave unrecorded
+                self.done[rid] = body
+                while len(self.done) > _DEDUPE_CAP:
+                    old_rid, _ = self.done.popitem(last=False)
+                    # remember the high-water mark of evicted seqs
+                    # per session so a straggler can be refused
+                    # instead of re-applied (seqs are monotonic
+                    # per session, so max == newest evicted)
+                    s, q = _rid_session_seq(old_rid)
+                    if s is not None and q is not None:
+                        self.evicted[s] = max(
+                            q, self.evicted.get(s, -1))
+                        self.evicted.move_to_end(s)
+                        while len(self.evicted) > _SESSION_CAP:
+                            self.evicted.popitem(last=False)
+        if ev is not None:
+            ev.set()
+
+    # -- /tasks: the scheduler surface --------------------------------------
+
+    #: /tasks ops whose second application would change state (deduped);
+    #: "tick" is idempotent admission work and re-executes harmlessly
+    _TASKS_MUTATING = frozenset({"submit", "cancel"})
+
+    def _do_tasks(self) -> None:
+        """The multi-tenant scheduler surface (sched/scheduler.py):
+        ``submit`` / ``cancel`` (rid-deduped like every board mutation
+        — a retried submit cannot enqueue a task twice) and ``tick``
+        (idempotent admission).  Auth-gated like /rpc."""
+        length = int(self.headers.get("Content-Length", 0))
+        if not check_auth(self.auth_token, self.headers):
+            self.rfile.read(length)
+            _REQUESTS.inc(op="tasks:-", outcome="unauthorized")
+            return self._respond(401, b"{}")
+        try:
+            req = json.loads(self.rfile.read(length))
+            op = req["op"]
+            if op not in ("submit", "cancel", "tick"):
+                raise KeyError(op)
+        except (json.JSONDecodeError, KeyError, UnicodeDecodeError,
+                TypeError):
+            _REQUESTS.inc(op="tasks:-", outcome="bad_request")
+            return self._respond(400, b"{}")
+        rid = req.get("rid") if op in self._TASKS_MUTATING else None
+        if rid is not None:
+            answered = self._claim_rid(rid, f"tasks:{op}")
+            if answered is not None:
+                return self._respond(200, answered)
+        body = None
+        t_exec = time.monotonic()
+        try:
+            if op == "submit":
+                result = self.scheduler.submit(
+                    req["tenant"], db=req.get("db"),
+                    params=req.get("params"),
+                    priority=int(req.get("priority") or 0),
+                    weight=float(req.get("weight") or 1.0),
+                    est_jobs=int(req.get("est_jobs") or 0),
+                    est_bytes=int(req.get("est_bytes") or 0),
+                    kind=req.get("kind") or "server")
+            elif op == "cancel":
+                result = self.scheduler.cancel(
+                    req["task_id"], reason=req.get("reason") or "cancelled")
+            else:
+                result = self.scheduler.tick()
+            body = json.dumps({"ok": True, "result": result}).encode()
+            _REQUESTS.inc(op=f"tasks:{op}", outcome="ok")
+        except Exception as exc:
+            # same contract as /rpc: a reserved rid always gets a
+            # recorded response, and admission rejections travel as
+            # typed errors (QuotaExceededError carries its reason)
+            doc = {"ok": False, "type": type(exc).__name__,
+                   "error": str(exc)}
+            reason = getattr(exc, "reason", None)
+            if reason is not None:
+                doc["reason"] = reason
+            body = json.dumps(doc).encode()
+            _REQUESTS.inc(op=f"tasks:{op}", outcome="error")
+        finally:
+            _RPC_SECONDS.observe(time.monotonic() - t_exec,
+                                 op=f"tasks:{op}")
+            if rid is not None:
+                self._record_rid(rid, body)
         self._respond(200, body)
 
     def _do_telemetry(self) -> None:
@@ -270,7 +355,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         else, and orchestrator probes (k8s httpGet, load balancers)
         cannot send a bearer token."""
         if self.path not in ("/metrics", "/statusz", "/tracez",
-                             "/clusterz", "/healthz"):
+                             "/clusterz", "/healthz", "/tasks"):
             return self._respond(404, b"{}")
         if self.path == "/healthz":
             _SCRAPES.inc(path=self.path)
@@ -290,9 +375,16 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                 body = json.dumps(self.collector.cluster_doc(),
                                   default=float).encode()
                 ctype = "application/json"
+            elif self.path == "/tasks":
+                body = json.dumps(
+                    {"tasks": self.scheduler.list_tasks(),
+                     "sched": self.scheduler.snapshot()},
+                    default=float).encode()
+                ctype = "application/json"
             else:
                 body = json.dumps(cluster_status(
-                    self.store, collector=self.collector)).encode()
+                    self.store, collector=self.collector,
+                    scheduler=self.scheduler)).encode()
                 ctype = "application/json"
         except Exception as exc:
             # a scrape must never kill the handler thread mid-chaos; the
@@ -347,18 +439,31 @@ class DocServer:
 
     def __init__(self, store: Optional[DocStore] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 auth_token: Optional[str] = None) -> None:
+                 auth_token: Optional[str] = None,
+                 scheduler_config=None) -> None:
+        # late import: sched builds on coord (no cycle at module load)
+        from ..sched.scheduler import Scheduler, SchedulerConfig
+
+        bound_store = store if store is not None else MemoryDocStore()
         handler = type("BoundRpcHandler", (_RpcHandler,), {
-            "store": store if store is not None else MemoryDocStore(),
+            "store": bound_store,
             "done": collections.OrderedDict(),
             "inflight": {},
             "evicted": collections.OrderedDict(),
             "dedupe_lock": threading.Lock(),
             "auth_token": default_auth_token(auth_token),
             "collector": Collector(local_role="server"),
+            # every docserver hosts the multi-tenant scheduler surface;
+            # admission (tick) stays lease-fenced, so a board whose
+            # admission runs in a separate runner process simply never
+            # wins the lease here
+            "scheduler": Scheduler(
+                bound_store,
+                config=scheduler_config or SchedulerConfig()),
         })
         self.store = handler.store
         self.collector = handler.collector
+        self.scheduler = handler.scheduler
         self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None

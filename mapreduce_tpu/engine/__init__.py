@@ -12,3 +12,6 @@ until the final (small) aggregated result.
 from .device_engine import DeviceEngine, EngineConfig, DeviceResult  # noqa: F401
 from .wordcount import (  # noqa: F401
     DeviceWordCount, materialize_counts, wordcount_map_fn)
+from .session import (  # noqa: F401
+    EngineSession, SessionOverflowError, SessionStreamBroken)
+from .topk import TopKWords, topk_bytes  # noqa: F401

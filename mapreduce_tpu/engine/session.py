@@ -1,0 +1,316 @@
+"""Resident engine sessions: the reference's ``"loop"`` generalized to
+a continuous query.
+
+``DeviceEngine.run`` owns the mesh for one job: it builds a fresh
+accumulator, folds every wave, reads the result out, and the aggregate
+dies with the call.  An :class:`EngineSession` keeps everything that is
+expensive or stateful ALIVE across submissions instead:
+
+  * the fused wave program (and with it the compile ledger's warm
+    executable — a feed never recompiles);
+  * one donated on-device accumulator PER TASK, so waves from many
+    tenants multiplex over one mesh — each ``feed(records, task=...)``
+    threads exactly its own task's running uniques through the same
+    single-dispatch wave program the batch engine uses (PR 5's fold);
+  * :meth:`snapshot` reads the current per-partition aggregate out as
+    a consistent, finalfn-style result WITHOUT stopping the stream —
+    the accumulator arrays are only donated at the next feed's
+    dispatch, so a snapshot is a plain sliced readback of live arrays,
+    and the integer monoids the engine fuses (sum/min/max and any
+    exact ACI op) make it bit-identical to a from-scratch batch run
+    over the same records (tests/test_session.py pins this).
+
+Consistency contract: feeds and snapshots are serialized per session
+(one lock), so a snapshot observes a record-aligned prefix of the
+stream — every record of every completed ``feed`` call, none of a
+concurrent one.
+
+Capacity contract: the session CANNOT right-size capacities by retry —
+a stream has no replay (the batch engine re-uploads; a feed's records
+are gone once folded).  Overflow is therefore counted per stream and
+raised by default (:class:`SessionOverflowError`); size the config for
+the live set up front (``out_capacity`` bounds the number of DISTINCT
+keys resident, not the stream length).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..obs import metrics as _obs
+from ..utils.jax_compat import quiet_unusable_donation
+from .device_engine import (
+    AXIS, DeviceEngine, DeviceResult, EngineConfig, _DISPATCHES, _WAVES)
+
+_FEEDS = _obs.counter(
+    "mrtpu_session_feeds_total",
+    "EngineSession.feed calls (labels: task)")
+_CHUNKS = _obs.counter(
+    "mrtpu_session_chunks_total",
+    "input chunks folded into a resident session aggregate "
+    "(labels: task)")
+_SESSION_WAVES = _obs.counter(
+    "mrtpu_session_waves_total",
+    "fused wave programs dispatched by the session layer (labels: "
+    "task) — the bench smoke asserts device dispatches match this "
+    "one-for-one while the session is the only engine user")
+_SNAPSHOTS = _obs.counter(
+    "mrtpu_session_snapshots_total",
+    "mid-stream consistent reads of a session aggregate (labels: task)")
+_SESSION_SECONDS = _obs.counter(
+    "mrtpu_session_seconds_total",
+    "wall seconds in the session layer (labels: stage=feed|snapshot, "
+    "task)")
+_LIVE_RECORDS = _obs.gauge(
+    "mrtpu_session_records_live",
+    "live unique rows in a session's resident accumulator at the last "
+    "snapshot (labels: task)")
+_OVERFLOWS = _obs.counter(
+    "mrtpu_session_overflow_rows_total",
+    "rows a session stream dropped for capacity (labels: task); any "
+    "nonzero value means that stream's aggregate is truncated")
+
+
+class SessionOverflowError(RuntimeError):
+    """A feed overflowed a static capacity.  Unlike the batch engine a
+    session cannot retry with right-sized capacities (streams have no
+    replay), so the stream's aggregate is now TRUNCATED — raise the
+    config's capacities and restart the stream, or pass
+    ``on_overflow="count"`` to continue with counted loss."""
+
+
+class SessionStreamBroken(RuntimeError):
+    """A previous feed on this stream died mid-wave: some of its waves
+    were already folded into the accumulator (and the accumulator's
+    donated buffers may have been invalidated by the failed dispatch),
+    so the aggregate is neither the pre-feed nor the post-feed state —
+    retrying the feed would double-count the folded waves.  The stream
+    is POISONED: every feed/snapshot raises this until ``close(task)``
+    discards it and a fresh stream restarts from its source."""
+
+
+class _Stream:
+    """One task's resident state: the donated accumulator plus stream
+    counters.  ``pos`` is the global chunk index (payload offsets like
+    wordcount's byte positions stay stream-global across feeds)."""
+
+    __slots__ = ("acc", "pos", "waves", "feeds", "overflow", "broken")
+
+    def __init__(self, acc) -> None:
+        self.acc = acc
+        self.pos = 0
+        self.waves = 0
+        self.feeds = 0
+        self.overflow = 0
+        self.broken = False
+
+
+class EngineSession:
+    """A resident :class:`DeviceEngine` multiplexing task streams.
+
+    ``map_fn``/``config`` follow the engine's contract exactly; *k*
+    (chunks per device per wave) fixes the wave program's shape — it is
+    latched from the first feed when omitted, and every later feed of
+    any task reuses the same compiled program (sub-wave feeds pad, the
+    ``n_real`` mask keeps padding out of the fold exactly as the batch
+    path does)."""
+
+    def __init__(self, mesh, map_fn: Callable,
+                 config: EngineConfig = EngineConfig(),
+                 k: Optional[int] = None,
+                 task: str = "-") -> None:
+        #: the engine's own task label stays the session default; per-
+        #: feed labels ride the session counters
+        self.engine = DeviceEngine(mesh, map_fn, config, task=task)
+        self.config = config
+        self.k = int(k) if k else None
+        self.default_task = task
+        self._row_shape: Optional[tuple] = None
+        self._row_dtype = None
+        self._streams: Dict[str, _Stream] = {}
+        self._lock = threading.Lock()
+
+    # -- shape latching ----------------------------------------------------
+
+    def _latch(self, chunks: np.ndarray) -> None:
+        if self._row_shape is None:
+            self._row_shape = tuple(chunks.shape[1:])
+            self._row_dtype = chunks.dtype
+            if self.k is None:
+                row_bytes = max(1, chunks.nbytes // max(1, len(chunks)))
+                self.k = max(1, min(
+                    self.engine._rows_per_wave(row_bytes),
+                    -(-chunks.shape[0] // self.engine.n_dev)))
+        elif (tuple(chunks.shape[1:]) != self._row_shape
+                or chunks.dtype != self._row_dtype):
+            raise ValueError(
+                f"session rows are fixed at shape {self._row_shape} "
+                f"dtype {self._row_dtype} (got {tuple(chunks.shape[1:])} "
+                f"{chunks.dtype}); one program shape per session")
+
+    def warm(self) -> float:
+        """AOT-compile the session's wave program (requires the row
+        shape — feed once or construct with explicit *k* plus a first
+        feed); returns seconds spent.  With a persistent cache this is
+        the warm-start path for a restarted session host."""
+        if self._row_shape is None:
+            raise RuntimeError("warm() needs the row shape: feed once "
+                               "first (the shape is latched there)")
+        return self.engine.precompile(self._row_shape, self._row_dtype,
+                                      k=self.k)
+
+    # -- the stream --------------------------------------------------------
+
+    def tasks(self):
+        with self._lock:
+            return sorted(self._streams)
+
+    def _stream(self, task: str) -> _Stream:
+        st = self._streams.get(task)
+        if st is None:
+            acc = self.engine._acc_init(self.config, self._row_shape,
+                                        self._row_dtype)
+            st = self._streams[task] = _Stream(acc)
+        return st
+
+    def feed(self, chunks: np.ndarray, task: Optional[str] = None,
+             on_overflow: str = "raise") -> int:
+        """Fold *chunks* ([S, ...row] host array) into *task*'s resident
+        aggregate, one fused wave dispatch per k*n_dev chunk block —
+        identical to the batch engine's per-wave program, with THIS
+        task's accumulator threaded through as the donated carry.
+        Returns the rows this feed overflowed (0 = exact)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if on_overflow not in ("raise", "count"):
+            raise ValueError("on_overflow must be 'raise' or 'count', "
+                             f"got {on_overflow!r}")
+        task = self.default_task if task is None else str(task)
+        chunks = np.ascontiguousarray(chunks)
+        t0 = time.monotonic()
+        with self._lock:
+            self._latch(chunks)
+            eng = self.engine
+            st = self._stream(task)
+            if st.broken:
+                raise SessionStreamBroken(
+                    f"stream {task!r} broke in an earlier feed; "
+                    "close(task) and restart it from the source")
+            S = chunks.shape[0]
+            rpw = self.k * eng.n_dev
+            W = -(-S // rpw)
+            sharded = NamedSharding(eng.mesh, P(AXIS))
+            rep = NamedSharding(eng.mesh, P())
+            # the mask boundary: chunk indices >= n_real are padding
+            # (this feed's pad rows AND nothing of a later feed)
+            n_real = jax.device_put(np.int32(st.pos + S), rep)
+            fn = eng._get_compiled(self.config)
+            feed_oflow = 0
+            try:
+                with quiet_unusable_donation():
+                    for w in range(W):
+                        lo = w * rpw
+                        block = chunks[lo:lo + rpw]
+                        if block.shape[0] < rpw:  # final wave: pad
+                            pad = np.zeros(
+                                (rpw - block.shape[0],)
+                                + chunks.shape[1:], chunks.dtype)
+                            block = np.concatenate([block, pad])
+                        ci = jax.device_put(block, sharded)
+                        ii = jax.device_put(
+                            np.arange(st.pos + lo, st.pos + lo + rpw,
+                                      dtype=np.int32), sharded)
+                        out = fn(ci, ii, n_real, *st.acc)
+                        _DISPATCHES.inc(1, program="wave", task=task)
+                        # lanes 0-3 records, lane 6+ traffic — the next
+                        # wave's carry; lane 4 is the overflow readback
+                        # that also proves the wave finished (bounding
+                        # the dispatch queue to 1, the CPU-safe depth)
+                        st.acc = list(out[:4]) + list(out[6:])
+                        feed_oflow += int(eng._host(out[4]).sum())
+                        del out, ci, ii
+            except BaseException:
+                # a dispatch died mid-feed: waves 0..w-1 are already
+                # folded, wave w's donation may have invalidated the
+                # accumulator buffers, and st.pos never advanced — a
+                # retry would double-count.  Poison the stream (the
+                # contract is loud loss, never a silent wrong count).
+                st.broken = True
+                st.acc = None
+                raise
+            st.pos += S
+            st.waves += W
+            st.feeds += 1
+            st.overflow += feed_oflow
+            _WAVES.inc(W, task=task)
+            _SESSION_WAVES.inc(W, task=task)
+            _FEEDS.inc(task=task)
+            _CHUNKS.inc(S, task=task)
+            if feed_oflow:
+                _OVERFLOWS.inc(feed_oflow, task=task)
+            _SESSION_SECONDS.inc(time.monotonic() - t0, stage="feed",
+                                 task=task)
+        if feed_oflow and on_overflow == "raise":
+            raise SessionOverflowError(
+                f"session stream {task!r} overflowed {feed_oflow} rows "
+                f"(cumulative {st.overflow}); streams cannot "
+                "capacity-retry — raise EngineConfig capacities and "
+                "restart the stream")
+        return feed_oflow
+
+    def snapshot(self, task: Optional[str] = None) -> DeviceResult:
+        """Consistent mid-stream read of *task*'s aggregate: the same
+        sliced readback the batch engine's run epilogue does, over the
+        LIVE accumulator — nothing is donated, the stream continues.
+        ``overflow`` carries the stream's cumulative dropped rows (0 =
+        the aggregate is exact)."""
+        task = self.default_task if task is None else str(task)
+        t0 = time.monotonic()
+        with self._lock:
+            st = self._streams.get(task)
+            if st is None:
+                raise KeyError(f"no stream {task!r} in this session "
+                               f"(known: {sorted(self._streams)})")
+            if st.broken:
+                raise SessionStreamBroken(
+                    f"stream {task!r} broke in an earlier feed; its "
+                    "aggregate is unusable — close(task) and restart")
+            eng = self.engine
+            keys, vals, pay, valid = st.acc[:4]
+            n_live = eng._host(valid.sum(axis=1))
+            width = max(1, int(n_live.max()))
+            keys_h, vals_h, pay_h, valid_h = eng._host(
+                keys[:, :width], vals[:, :width], pay[:, :width],
+                valid[:, :width])
+            # captured INSIDE the lock: a concurrent feed's overflow
+            # must not be pinned on values this snapshot never saw
+            overflow = st.overflow
+            _SNAPSHOTS.inc(task=task)
+            _LIVE_RECORDS.set(int(np.asarray(n_live).sum()), task=task)
+            _SESSION_SECONDS.inc(time.monotonic() - t0, stage="snapshot",
+                                 task=task)
+        return DeviceResult(keys_h, vals_h, pay_h, valid_h, overflow)
+
+    def stats(self, task: Optional[str] = None) -> Dict[str, int]:
+        """Stream counters (chunks/waves/feeds/overflow) for *task*."""
+        task = self.default_task if task is None else str(task)
+        with self._lock:
+            st = self._streams.get(task)
+            if st is None:
+                return {}
+            return {"chunks": st.pos, "waves": st.waves,
+                    "feeds": st.feeds, "overflow": st.overflow}
+
+    def close(self, task: Optional[str] = None) -> None:
+        """Drop one stream's (or every stream's) resident accumulator —
+        its HBM frees with the references."""
+        with self._lock:
+            if task is not None:
+                self._streams.pop(str(task), None)
+            else:
+                self._streams.clear()

@@ -1,0 +1,216 @@
+"""Top-K heavy hitters over a streaming engine session.
+
+ROADMAP item 4's "streaming-session killer app": the fused engine
+already maintains EVERY key's exact running count on device (the
+donated accumulator is the full aggregate, not a sketch), so top-K is
+a selection over the resident state — the bounded output rides out at
+snapshot time while the stream keeps flowing.  Exactness comes for
+free: with ``out_capacity`` >= the distinct-key count the counts are
+exact (no Misra-Gries/CMS approximation), and any capacity loss is
+COUNTED (``DeviceResult.overflow`` / the session overflow counter),
+never silent.
+
+Two forms:
+
+  * :class:`TopKWords` — streaming: ``feed(bytes)`` folds text into a
+    resident :class:`~.session.EngineSession` (the wordcount map_fn's
+    hash/compact pipeline), ``topk()`` reads the K heaviest words out
+    mid-stream.  The original chunk bytes are retained HOST-side for
+    materialisation (HBM holds only the aggregate) — bound the stream
+    or use hash-only mode (``materialize=False``) for unbounded runs.
+  * :func:`topk_bytes` — batch: one ``DeviceWordCount`` run (full
+    capacity/retry machinery — overflow right-sizes and re-runs), then
+    the same selection.  The golden test pins both against a host
+    recount.
+
+Tie-breaking is deterministic: heaviest count first, then lexicographic
+word order — so equal-count boundaries cannot flap between runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .device_engine import EngineConfig
+from .session import EngineSession
+from .wordcount import _wordcount_map_fn, gather_words
+
+
+def _select_topk(result, k: int, resolve=None):
+    """Shared selection over a DeviceResult: rank live rows by count
+    (desc), materialise the candidates' words via *resolve* (global
+    byte offsets -> word bytes), break count ties by word.  Returns
+    ``[(word, count)]`` (or ``[(None, count)]`` when *resolve* is
+    None — hash-only mode)."""
+    valid = np.asarray(result.valid).reshape(-1)
+    vals = np.asarray(result.values).reshape(-1)
+    pay = np.asarray(result.payload)
+    starts = pay.reshape(-1, pay.shape[-1])[:, 0]
+    live = np.nonzero(valid)[0]
+    if live.size == 0:
+        return []
+    counts = vals[live].astype(np.int64)
+    # rank by count desc; take enough candidates to cover count ties at
+    # the K boundary, then settle ties lexicographically by word
+    order = np.argsort(-counts, kind="stable")
+    if live.size > k:
+        kth = counts[order[k - 1]]
+        n_cand = int(np.searchsorted(-counts[order], -kth, side="right"))
+    else:
+        n_cand = live.size
+    cand = order[:n_cand]
+    if resolve is None:
+        pairs = sorted(((int(counts[i]), int(starts[live[i]]))
+                        for i in cand), key=lambda p: (-p[0], p[1]))
+        return [(None, c) for c, _ in pairs[:k]]
+    words = resolve(starts[live[cand]].astype(np.int64))
+    pairs = sorted(zip(words, (int(counts[i]) for i in cand)),
+                   key=lambda wc: (-wc[1], wc[0]))
+    return pairs[:k]
+
+
+def _gather_candidate_rows(chunk_arrays, gstarts: np.ndarray,
+                           row_len: int):
+    """Materialisation input for CANDIDATE offsets only: the ~K rows
+    the offsets live in, compacted from the retained per-feed chunk
+    arrays, with the offsets remapped into the compact array — a
+    mid-stream topk() poll costs O(K rows), never a concatenation of
+    everything ever fed.  Sound because a word (plus its terminating
+    whitespace for sub-window words) never crosses its own row
+    (shard_text cuts at whitespace and space-pads every row)."""
+    rows = np.asarray(gstarts, dtype=np.int64) // row_len
+    uniq, inv = np.unique(rows, return_inverse=True)
+    bounds = np.cumsum([0] + [c.shape[0] for c in chunk_arrays])
+    sel = np.empty((uniq.size, row_len), dtype=chunk_arrays[0].dtype)
+    for j, g in enumerate(uniq):
+        li = int(np.searchsorted(bounds, g, side="right") - 1)
+        sel[j] = chunk_arrays[li][int(g - bounds[li])]
+    local = (inv.astype(np.int64) * row_len
+             + np.asarray(gstarts, dtype=np.int64) % row_len)
+    return sel, local
+
+
+def default_topk_config(chunk_len: int) -> EngineConfig:
+    """Capacities sized for natural-language heavy-hitter streams; the
+    resident set is the DISTINCT-key count, not the stream length."""
+    return EngineConfig(
+        local_capacity=1 << 15, exchange_capacity=1 << 13,
+        out_capacity=1 << 16, combine_in_scan=True,
+        # explicit combiner slots: a session stream cannot capacity-
+        # retry, so the per-chunk combine capacity must cover a dense
+        # chunk's uniques up front (the batch auto of T//4 is tuned
+        # for the retrying path)
+        combine_capacity=1 << 13,
+        unit_values=True, reduce_op="sum")
+
+
+class TopKWords:
+    """Streaming top-K heavy-hitter words over an engine session."""
+
+    def __init__(self, mesh, k: int = 100, chunk_len: int = 1 << 14,
+                 config: Optional[EngineConfig] = None,
+                 materialize: bool = True, task: str = "topk") -> None:
+        cfg = config or default_topk_config(chunk_len)
+        cfg = replace(cfg, unit_values=True, reduce_op="sum",
+                      tile=min(cfg.tile, chunk_len))
+        self.k = int(k)
+        self.chunk_len = chunk_len
+        self.config = cfg
+        self.task = task
+        self.materialize = materialize
+        #: one padded chunk length for every feed (the wordcount
+        #: whitespace-overhang slack), so the session's program shape
+        #: is feed-size-independent
+        self.row_len = chunk_len + cfg.tile
+        self.session = EngineSession(mesh, _wordcount_map_fn, cfg,
+                                     task=task)
+        self._chunks: List[np.ndarray] = []
+        #: the ACTUAL padded row width shard_text produced (it rounds
+        #: pad_to up to a tile multiple and grows past it for long
+        #: whitespace-free spans) — the device payload offsets are
+        #: chunk_index * THIS, so materialisation must use it, never
+        #: the requested row_len
+        self._L: Optional[int] = None
+        self._bytes_fed = 0
+
+    def feed(self, data: bytes) -> None:
+        """Fold *data*'s words into the resident aggregate (the stream
+        keeps its global byte offsets, so a word first seen feeds ago
+        still materialises)."""
+        from ..ops.tokenize import shard_text
+
+        n_chunks = max(1, -(-len(data) // self.chunk_len))
+        chunks, L = shard_text(data, n_chunks,
+                               pad_multiple=self.config.tile,
+                               pad_to=self.row_len)
+        if self._L is None:
+            self._L = int(L)
+        # the device payload offset is int32 (chunk_index * L + local):
+        # a materialising stream past ~2 GiB would wrap it NEGATIVE and
+        # topk() would pair real counts with garbled words — refuse
+        # LOUDLY instead (hash-only mode never reads offsets, so
+        # materialize=False streams stay unbounded)
+        if self.materialize:
+            pos = self.session.stats(self.task).get("chunks", 0)
+            end = (pos + chunks.shape[0]) * self._L
+            if end > 2**31 - 1:
+                raise OverflowError(
+                    f"materialising top-K stream would reach byte "
+                    f"offset {end} (> int32 payload range); restart "
+                    "the stream, or use materialize=False for "
+                    "unbounded hash-only streaming")
+        # the session latches one program shape; a feed whose data
+        # forces a wider row (an over-long whitespace-free span) gets
+        # the session's clear shape error rather than silent garble
+        self.session.feed(chunks, task=self.task)
+        if self.materialize:
+            self._chunks.append(chunks)
+        self._bytes_fed += len(data)
+
+    def _resolve_words(self, gstarts: np.ndarray) -> List[bytes]:
+        sel, local = _gather_candidate_rows(self._chunks, gstarts,
+                                            self._L)
+        return gather_words(sel, local)
+
+    def topk(self, k: Optional[int] = None,
+             ) -> List[Tuple[bytes, int]]:
+        """The K heaviest words so far — a mid-stream session snapshot
+        plus host selection over just the candidates' rows (a poll is
+        O(K), not O(bytes fed)); the stream is NOT stopped."""
+        result = self.session.snapshot(self.task)
+        resolve = (self._resolve_words
+                   if self.materialize and self._chunks else None)
+        return _select_topk(result, k or self.k, resolve=resolve)
+
+    def stats(self) -> dict:
+        st = dict(self.session.stats(self.task))
+        st["bytes_fed"] = self._bytes_fed
+        return st
+
+
+def topk_bytes(mesh, data: bytes, k: int = 100,
+               chunk_len: int = 1 << 14,
+               config: Optional[EngineConfig] = None,
+               ) -> List[Tuple[bytes, int]]:
+    """Batch top-K: one ``DeviceWordCount``-shaped engine run with the
+    FULL capacity/retry machinery (an overflowing run right-sizes and
+    re-runs — exactness is guaranteed, not hoped for), then the same
+    deterministic selection the streaming form uses."""
+    from .wordcount import DeviceWordCount
+
+    wc = DeviceWordCount(mesh, chunk_len=chunk_len, config=config)
+    chunks, L = wc._to_chunks(data)
+    result = wc._engine_for(L).run(chunks)
+    return _select_topk(result, k,
+                        resolve=lambda g: gather_words(chunks, g))
+
+
+def host_topk(data: bytes, k: int) -> List[Tuple[bytes, int]]:
+    """Pure-host golden: split/count/sort, same tie-break contract."""
+    counts: dict = {}
+    for w in data.split():
+        counts[w] = counts.get(w, 0) + 1
+    return sorted(counts.items(), key=lambda wc: (-wc[1], wc[0]))[:k]

@@ -618,6 +618,43 @@ def _phase_breakdown(doc: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# -- multi-tenant service findings (sched/ + engine/session) -----------------
+
+
+def _sched_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Tenancy health from the cluster-aggregated scheduler/session
+    families: queue depth per tenant/state, admission rejections by
+    reason, served records, and streaming-session overflow."""
+    depth: Dict[str, Dict[str, int]] = {}
+    rejections: Dict[str, Dict[str, int]] = {}
+    served: Dict[str, int] = {}
+    overflow: Dict[str, int] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name == "mrtpu_sched_queue_depth" and value:
+            depth.setdefault(labels.get("tenant", "-"), {})[
+                labels.get("state", "?")] = int(value)
+        elif (name == "mrtpu_sched_admission_total"
+                and labels.get("outcome") == "rejected" and value):
+            rejections.setdefault(labels.get("tenant", "-"), {})[
+                labels.get("reason", "-")] = int(value)
+        elif name == "mrtpu_sched_served_records_total" and value:
+            t = labels.get("tenant", "-")
+            served[t] = served.get(t, 0) + int(value)
+        elif name == "mrtpu_session_overflow_rows_total" and value:
+            t = labels.get("task", "-")
+            overflow[t] = overflow.get(t, 0) + int(value)
+    out: Dict[str, Any] = {}
+    if depth:
+        out["queue_depth"] = depth
+    if rejections:
+        out["rejections"] = rejections
+    if served:
+        out["served_records"] = served
+    if overflow:
+        out["session_overflow"] = overflow
+    return out
+
+
 # -- the report --------------------------------------------------------------
 
 
@@ -642,6 +679,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "compile_hotspots": _compile_hotspots(doc, top_k),
         "memory": _memory_findings(doc),
         "comms": comms,
+        "sched": _sched_findings(doc),
         "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
         "trace_events": len(doc.get("traceEvents") or []),
@@ -713,6 +751,19 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
             "device {} memory pressure: {:.3g} of {:.3g} bytes in use "
             "({:.0%})".format(p["device"], float(p["bytes_in_use"]),
                               float(p["bytes_limit"]), p["ratio"]))
+    for tenant, reasons in sorted(
+            (report["sched"].get("rejections") or {}).items()):
+        total = sum(reasons.values())
+        worst = max(reasons, key=reasons.get)
+        notes.append(
+            f"tenant {tenant}: {total} admission rejection(s), mostly "
+            f"{worst} — raise its quota or drain its queue")
+    for task, rows in sorted(
+            (report["sched"].get("session_overflow") or {}).items()):
+        notes.append(
+            f"session stream {task} dropped {rows} rows for capacity — "
+            "its resident aggregate is truncated; raise EngineConfig "
+            "capacities and restart the stream")
     hot_compile = report["compile_hotspots"]
     if hot_compile and hot_compile[0]["total_s"] >= 5.0:
         h = hot_compile[0]
@@ -814,6 +865,16 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
                 "device execution{}".format(
                     cp["upload_overlap_frac"], cp.get("upload_s", 0.0),
                     " (FEEDER-BOUND)" if cp.get("feeder_bound") else ""))
+
+    sched = report.get("sched") or {}
+    if sched.get("queue_depth") or sched.get("served_records"):
+        lines.append("scheduler (multi-tenant service):")
+        for t, states in sorted((sched.get("queue_depth") or {}).items()):
+            parts = " ".join(f"{s}={n}"
+                             for s, n in sorted(states.items()))
+            lines.append(f"  tenant {t}: {parts}")
+        for t, n in sorted((sched.get("served_records") or {}).items()):
+            lines.append(f"  tenant {t}: {n} records served")
 
     comp = report.get("compile_hotspots") or []
     if comp:

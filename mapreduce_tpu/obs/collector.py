@@ -152,6 +152,15 @@ DIAG_FAMILIES = frozenset({
     "mrtpu_comms_modeled_exchange_seconds",
     "mrtpu_comms_exchange_frac_of_compute",
     "mrtpu_upload_overlap_frac",
+    # the multi-tenant service plane (sched/ + engine/session): queue
+    # depths, admission rejections and per-tenant served-records roll
+    # up to /clusterz so diagnose sees tenancy health cluster-wide;
+    # session counters carry the per-task streaming volume
+    "mrtpu_sched_queue_depth", "mrtpu_sched_queued_work",
+    "mrtpu_sched_admission_total", "mrtpu_sched_tasks_total",
+    "mrtpu_sched_served_records_total",
+    "mrtpu_session_chunks_total", "mrtpu_session_waves_total",
+    "mrtpu_session_overflow_rows_total",
 })
 
 #: diagnosis gauges that must merge across processes by MAX, not sum:
@@ -163,6 +172,9 @@ DIAG_FAMILIES = frozenset({
 _DIAG_GAUGE_MAX = frozenset({
     "mrtpu_device_memory_bytes",
     "mrtpu_device_donation_saved_bytes",
+    # queue depths are board-authoritative on whichever process hosts
+    # the scheduler; a second process's stale view must not sum in
+    "mrtpu_sched_queue_depth", "mrtpu_sched_queued_work",
     # last-run gauges, not cluster-additive quantities: two processes'
     # imbalance (or modeled seconds) must not sum into a fiction — the
     # worst process's view is what diagnosis wants

@@ -171,14 +171,15 @@ def comms_snapshot_section() -> Dict[str, Any]:
 
 
 def cluster_status(store, now: Optional[float] = None,
-                   collector=None) -> Dict[str, Any]:
+                   collector=None, scheduler=None) -> Dict[str, Any]:
     """The /statusz document: one entry per task database on the board,
     plus the serving process's device-plane section (engine FLOPs/MFU —
     nonzero only where the engine actually ran; per-task device numbers
     travel in the persisted ``stats.device`` doc either way), the build
-    identity, and — when the serving process hosts a telemetry
-    *collector* (obs/collector) — the cluster's per-task roll-ups and
-    per-process push health."""
+    identity, the multi-tenant *scheduler*'s queue/quota snapshot (when
+    the serving process hosts one — sched/scheduler.py), and — when the
+    serving process hosts a telemetry *collector* (obs/collector) — the
+    cluster's per-task roll-ups and per-process push health."""
     from ..coord.lease import TrainerLease  # late: coord pulls obs
     from .buildinfo import build_info
     from .profile import device_snapshot  # late: profile pulls trace
@@ -199,6 +200,10 @@ def cluster_status(store, now: Optional[float] = None,
     comms = comms_snapshot_section()
     if comms:
         out["comms"] = comms
+    if scheduler is not None:
+        sched = scheduler.snapshot()
+        if sched:
+            out["sched"] = sched
     if collector is not None:
         out["telemetry"] = collector.summary()
     for db, colls in sorted(_dbnames(store).items()):

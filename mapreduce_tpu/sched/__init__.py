@@ -1,0 +1,26 @@
+"""Multi-tenant scheduler: the service layer over the job board.
+
+The reference plans ONE task per server process and polls it to
+completion (server.lua:464-609); production traffic means many
+concurrent tasks from many tenants sharing one board and one device
+mesh (ROADMAP item 3).  This package is that service layer:
+
+  * :mod:`.scheduler` — the board-resident task queue: per-tenant
+    queues with priority + weighted-fair dequeue, admission control
+    (global in-flight bound, per-tenant quotas on queued jobs/bytes),
+    crash-safe state (every decision is a document mutation) and
+    lease-fenced scheduler ownership (coord/lease.py patterns).  The
+    docserver hosts one and speaks ``/tasks`` (submit/list/cancel,
+    rid-deduped like every other board mutation).
+  * :mod:`.service` — the serving processes: a :class:`TaskRunner`
+    that drives admitted tasks through the unchanged ``Server``
+    machinery, and :class:`ScheduledWorker` — ONE worker loop serving
+    every admitted tenant's job board through the existing ``Task``
+    claim machinery.
+"""
+
+from .scheduler import (  # noqa: F401
+    QuotaExceededError, Scheduler, SchedulerClient, SchedulerConfig,
+    SchedulerFencedError, SchedulerLease)
+from .service import (  # noqa: F401
+    ScheduledWorker, TaskRunner, spawn_scheduled_workers)

@@ -1,0 +1,5 @@
+"""Tenant-A witness wordcount module (see tests/sched_mods.py)."""
+
+from tests.sched_mods import roles
+
+globals().update(roles("a"))

@@ -1,0 +1,5 @@
+"""Tenant-C witness wordcount module (see tests/sched_mods.py)."""
+
+from tests.sched_mods import roles
+
+globals().update(roles("c"))

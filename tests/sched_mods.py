@@ -1,0 +1,83 @@
+"""Per-tenant instrumented wordcount modules for the scheduler tests.
+
+The chaos_mods witness pattern (STARTED at map entry, COMPLETED after
+the last emit — so exactly-once is PROVEN by counting executions, not
+inferred from a correct-looking result), replicated per tenant: each
+tenant runs its OWN importable module (tests/sched_mod_a.py etc. are
+one-line shims binding :func:`roles` to a name), because the scheduler
+serves N tasks in one process and module-level state must not mix
+tenants the way one shared chaos_mods would.
+
+No ``init`` hook on purpose: the test configures state directly via
+:func:`reset` — module init is deduped per process by function
+identity (spec.ensure_init), so N tenants sharing one module could not
+each deliver their own init_args anyway.
+"""
+
+import collections
+import threading
+from typing import Any, Dict, List
+
+from mapreduce_tpu.utils.hashing import fnv1a32
+
+
+class TenantState:
+    def __init__(self) -> None:
+        self.files: List[str] = []
+        self.num_reducers = 3
+        self.RESULT: Dict[str, int] = {}
+        self.STARTED: "collections.Counter" = collections.Counter()
+        self.COMPLETED: "collections.Counter" = collections.Counter()
+        self.lock = threading.Lock()
+
+
+STATES: Dict[str, TenantState] = {}
+
+
+def state(name: str) -> TenantState:
+    return STATES.setdefault(name, TenantState())
+
+
+def reset(name: str, files, num_reducers: int = 3) -> TenantState:
+    st = STATES[name] = TenantState()
+    st.files = list(files)
+    st.num_reducers = num_reducers
+    return st
+
+
+def roles(name: str) -> Dict[str, Any]:
+    """The role-function dict a shim module splats into its globals."""
+    def taskfn(emit) -> None:
+        for i, path in enumerate(state(name).files):
+            emit(i, path)
+
+    def mapfn(key: Any, value: str, emit) -> None:
+        st = state(name)
+        with st.lock:
+            st.STARTED[key] += 1
+        with open(value, "r") as f:
+            for line in f:
+                for word in line.split():
+                    emit(word, 1)
+        # reached only if every emit went through (a fenced run dies at
+        # its first emit after the fence drops)
+        with st.lock:
+            st.COMPLETED[key] += 1
+
+    def partitionfn(key: str) -> int:
+        return fnv1a32(key.encode()) % state(name).num_reducers
+
+    def reducefn(key: str, values: List[int]) -> int:
+        return sum(values)
+
+    def finalfn(pairs) -> bool:
+        st = state(name)
+        st.RESULT.clear()
+        for key, values in pairs:
+            st.RESULT[key] = values[0]
+        return True
+
+    return {"taskfn": taskfn, "mapfn": mapfn, "partitionfn": partitionfn,
+            "reducefn": reducefn, "finalfn": finalfn,
+            "associative_reducer": True, "commutative_reducer": True,
+            "idempotent_reducer": True}

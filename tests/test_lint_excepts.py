@@ -191,6 +191,15 @@ _MONOTONIC_ONLY_MODULES = {
     os.path.join("mapreduce_tpu", "models", "trainer.py"),
     os.path.join("mapreduce_tpu", "models", "checkpoint.py"),
     os.path.join("mapreduce_tpu", "coord", "lease.py"),
+    # the always-on service plane: the session layer's feed/snapshot
+    # seconds are duration metrics and the scheduler's fair-share /
+    # admission arithmetic must never read a steppable clock (its
+    # persisted submit/admit timestamps are minted through
+    # coord/docstore.now); sched/service.py's poll/wait loops likewise
+    os.path.join("mapreduce_tpu", "sched", "scheduler.py"),
+    os.path.join("mapreduce_tpu", "sched", "service.py"),
+    os.path.join("mapreduce_tpu", "engine", "session.py"),
+    os.path.join("mapreduce_tpu", "engine", "topk.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions

@@ -1,0 +1,558 @@
+"""Multi-tenant scheduler unit tests (sched/scheduler.py + service.py):
+admission control, weighted-fair + priority dequeue, cancel semantics
+("a cancelled task's queued jobs never run"), crash-safe board state,
+lease-fenced admission, the rid-deduped /tasks HTTP surface, and the
+two-Servers-one-process regression the scheduler path fixes."""
+
+import json
+import os
+import uuid
+
+import pytest
+
+from mapreduce_tpu.coord.docserver import DocServer
+from mapreduce_tpu.coord.docstore import MemoryDocStore
+from mapreduce_tpu.coord.task import Task
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.obs.statusz import cluster_status
+from mapreduce_tpu.sched.scheduler import (
+    ADMITTED, CANCELLED, DONE, QUEUED, RUNNING, QuotaExceededError,
+    Scheduler, SchedulerClient, SchedulerConfig, SchedulerFencedError,
+    TASKS_COLL)
+from mapreduce_tpu.sched.service import (
+    ScheduledWorker, TaskRunner, spawn_scheduled_workers, wait_for_state)
+from mapreduce_tpu.utils.constants import STATUS, TASK_STATUS
+from tests import sched_mods
+
+
+def _sched(store=None, **cfg):
+    store = store or MemoryDocStore()
+    return Scheduler(store, config=SchedulerConfig(**cfg))
+
+
+# -- admission control -------------------------------------------------------
+
+
+def test_quota_queued_tasks():
+    s = _sched(tenant_max_queued_tasks=2)
+    s.submit("a")
+    s.submit("a")
+    with pytest.raises(QuotaExceededError) as ei:
+        s.submit("a")
+    assert ei.value.reason == "queued_tasks"
+    # another tenant is unaffected (quotas are per-tenant)
+    s.submit("b")
+    assert REGISTRY.value("mrtpu_sched_admission_total", tenant="a",
+                          outcome="rejected", reason="queued_tasks") >= 1
+
+
+def test_quota_queued_jobs_and_bytes():
+    s = _sched(tenant_max_queued_jobs=10, tenant_max_queued_bytes=100)
+    s.submit("a", est_jobs=8, est_bytes=50)
+    with pytest.raises(QuotaExceededError) as ei:
+        s.submit("a", est_jobs=5)
+    assert ei.value.reason == "queued_jobs"
+    with pytest.raises(QuotaExceededError) as ei:
+        s.submit("a", est_jobs=1, est_bytes=60)
+    assert ei.value.reason == "queued_bytes"
+    # admitted tasks leave the queue: quota frees up
+    s.tick()
+    s.submit("a", est_jobs=9)
+
+
+def test_duplicate_active_db_rejected():
+    """The two-Servers-one-db hazard fix: a db already queued/admitted/
+    running refuses a second task (their stats gauges share the db
+    label — interleaved publish/read-back would persist each other's
+    numbers), and frees up once the first reaches a terminal state."""
+    s = _sched()
+    d = s.submit("a", db="shared")
+    with pytest.raises(QuotaExceededError) as ei:
+        s.submit("b", db="shared")
+    assert ei.value.reason == "db_active"
+    s.tick()
+    s.mark_running(d["_id"])
+    with pytest.raises(QuotaExceededError):
+        s.submit("b", db="shared")
+    s.mark_done(d["_id"])
+    s.submit("b", db="shared")  # terminal: the db is free again
+
+
+def test_max_inflight_bounds_admission():
+    s = _sched(max_inflight=2)
+    ids = [s.submit("a")["_id"] for _ in range(4)]
+    admitted = s.tick()
+    assert len(admitted) == 2
+    assert s.tick() == []  # budget full
+    s.mark_running(ids[0])
+    assert s.mark_done(ids[0]) is not None
+    assert len(s.tick()) == 1  # one slot freed
+
+
+def test_db_guard_is_atomic_across_scheduler_instances():
+    """The one-Server-per-db guard must hold for TWO schedulers over
+    one shared store (a process-local lock cannot): the reservation is
+    a guarded board upsert, so exactly one submit wins — and a crashed
+    submit's dangling reservation (no task doc, past the grace window)
+    is reclaimable by a guarded steal."""
+    from mapreduce_tpu.sched.scheduler import DBS_COLL
+
+    store = MemoryDocStore()
+    s1, s2 = Scheduler(store), Scheduler(store)
+    # the primitive itself: first reserve wins, second loses, release
+    # by the owner frees it for the other instance
+    assert s1._reserve_db("shared", "t-1")
+    assert not s2._reserve_db("shared", "t-2")
+    s1._release_db({"_id": "t-1", "db": "shared"})
+    assert s2._reserve_db("shared", "t-2")
+    # a non-owner's release is a no-op, never a theft
+    s1._release_db({"_id": "t-1", "db": "shared"})
+    assert store.find_one(DBS_COLL, {"_id": "shared"})["task"] == "t-2"
+    # full submit path across instances: the loser is rejected even
+    # though it never saw the winner through its own local lock
+    d1 = s1.submit("a", db="race")
+    with pytest.raises(QuotaExceededError) as ei:
+        s2.submit("b", db="race")
+    assert ei.value.reason == "db_active"
+    s1.tick()
+    s1.mark_running(d1["_id"])
+    s1.mark_done(d1["_id"])
+    s2.submit("b", db="race")  # terminal released the reservation
+    # stale-reclaim: a reservation whose task doc never appeared is
+    # protected inside the grace window, stealable past it
+    assert s1._reserve_db("leak", "ghost")
+    assert not s2._reserve_db("leak", "t-3")
+    store.update(DBS_COLL, {"_id": "leak"},
+                 {"$set": {"reserved_time":
+                           1.0}})  # long past any grace
+    assert s2._reserve_db("leak", "t-3")
+
+
+# -- dequeue order -----------------------------------------------------------
+
+
+def test_weighted_fair_dequeue():
+    """Tenant b at weight 3 is admitted ~3x as often as tenant a at
+    weight 1: served_cost/weight picks the next tenant, so the
+    admission sequence is deterministic."""
+    s = _sched(max_inflight=1)
+    for _ in range(4):
+        s.submit("a", weight=1.0, est_jobs=1)
+    for _ in range(8):
+        s.submit("b", weight=3.0, est_jobs=1)
+    order = []
+    for _ in range(12):
+        got = s.tick()
+        assert len(got) == 1
+        order.append(got[0]["tenant"])
+        s.mark_running(got[0]["_id"])
+        s.mark_done(got[0]["_id"])
+    # both start at cost 0 (tie -> a), then b runs 3 per a's 1
+    assert order == ["a", "b", "b", "b", "a", "b", "b", "b", "a",
+                     "b", "b", "a"]
+
+
+def test_priority_then_submit_order_within_tenant():
+    s = _sched(max_inflight=1)
+    first = s.submit("a", priority=0)
+    urgent = s.submit("a", priority=5)
+    second = s.submit("a", priority=0)
+    order = []
+    for _ in range(3):
+        got = s.tick()
+        assert len(got) == 1
+        order.append(got[0]["_id"])
+        s.mark_running(got[0]["_id"])
+        s.mark_done(got[0]["_id"])
+    assert order == [urgent["_id"], first["_id"], second["_id"]]
+
+
+# -- cancel ------------------------------------------------------------------
+
+
+def test_cancel_queued_task_never_admitted():
+    s = _sched(max_inflight=1)
+    keep = s.submit("a")
+    doomed = s.submit("b")
+    assert s.cancel(doomed["_id"])["state"] == CANCELLED
+    admitted = s.tick()
+    assert [t["_id"] for t in admitted] == [keep["_id"]]
+    assert s.tick() == []  # nothing left: the cancelled task is gone
+    assert s.get(doomed["_id"])["state"] == CANCELLED
+    # terminal: cancelling again is a no-op, not a resurrection
+    assert s.cancel(doomed["_id"]) is None
+
+
+def test_cancelled_tasks_queued_jobs_never_run():
+    """The board-level guarantee: cancel forces the task db FINISHED
+    and removes claimable jobs, so a worker that already polled the db
+    gets nothing from either direction."""
+    store = MemoryDocStore()
+    s = Scheduler(store)
+    doc = s.submit("a", db="victim")
+    s.tick()
+    # the task planned jobs on its board (what a driver would do)
+    store.update("victim.task", {"_id": "unique"},
+                 {"_id": "unique", "status": TASK_STATUS.MAP.value,
+                  "iteration": 1}, upsert=True)
+    for i in range(3):
+        store.insert("victim.map_jobs",
+                     {"_id": f"j{i}", "status": int(STATUS.WAITING),
+                      "repetitions": 0})
+    store.insert("victim.map_jobs",
+                 {"_id": "jb", "status": int(STATUS.BROKEN),
+                  "repetitions": 1})
+    s.cancel(doc["_id"])
+    from mapreduce_tpu.coord.connection import Connection
+
+    # a worker claiming AFTER the cancel: the task reads FINISHED, so
+    # take_next_jobs returns nothing — and the claimable docs are gone
+    # anyway, so even a stale-status race has nothing to claim
+    cnn = Connection("mem://nope", "victim")
+    cnn._store = store
+    task = Task(cnn)
+    got, st = task.take_next_jobs("w0", "tmp", 4)
+    assert got == [] and st == TASK_STATUS.FINISHED
+    assert store.count("victim.map_jobs",
+                       {"status": {"$in": [int(STATUS.WAITING),
+                                           int(STATUS.BROKEN)]}}) == 0
+
+
+def test_terminal_task_docs_are_retained_then_pruned():
+    """An always-on service must not grow its board with every task it
+    ever served: terminal docs beyond keep_terminal_tasks age out
+    (oldest first), tenant accounting survives in the tenants doc, and
+    active tasks are never touched."""
+    s = _sched(max_inflight=2, keep_terminal_tasks=3)
+    done_ids = []
+    for i in range(6):
+        d = s.submit("a", est_jobs=1)
+        s.tick()
+        s.mark_running(d["_id"])
+        s.mark_done(d["_id"], records=2)
+        done_ids.append(d["_id"])
+    live = s.submit("a")
+    remaining = [d["_id"] for d in s.list_tasks()]
+    assert live["_id"] in remaining
+    assert remaining.count(live["_id"]) == 1
+    kept_done = [i for i in done_ids if i in remaining]
+    assert kept_done == done_ids[-3:], kept_done  # newest 3 survive
+    snap = s.snapshot()
+    assert snap["tenants"]["a"]["served_records"] == 12  # all 6 counted
+
+
+def test_gc_never_prunes_a_reservation_holding_task():
+    """A cancelled-while-RUNNING task still holds its db reservation
+    until the driver releases; GC pruning its doc would make the
+    reservation look like an ancient crashed submit and stealable —
+    the retention pass must skip reservation holders."""
+    s = _sched(max_inflight=2, keep_terminal_tasks=1)
+    drain = s.submit("a", db="gc-drain")
+    s.tick()
+    s.mark_running(drain["_id"])
+    s.cancel(drain["_id"])  # RUNNING cancel: reservation deliberately kept
+    for _ in range(4):  # plenty of newer terminal docs to trip the GC
+        d = s.submit("b")
+        s.tick()
+        s.mark_running(d["_id"])
+        s.mark_done(d["_id"])
+    assert s.get(drain["_id"]) is not None, (
+        "GC pruned the reservation-holding task doc")
+    with pytest.raises(QuotaExceededError):  # still refused, not stolen
+        s.submit("c", db="gc-drain")
+
+
+def test_cancel_of_running_task_defers_db_release():
+    """cancel(RUNNING) must NOT free the db while the driver is still
+    draining Server.loop (a resubmit would start a second Server on
+    the db); the driver's exit path releases, and only then does a
+    resubmit succeed."""
+    s = _sched()
+    d = s.submit("a", db="draining")
+    s.tick()
+    s.mark_running(d["_id"])
+    assert s.cancel(d["_id"])["state"] == CANCELLED
+    with pytest.raises(QuotaExceededError) as ei:
+        s.submit("b", db="draining")
+    assert ei.value.reason == "db_active"
+    # the driver exits: mark_done reports the cancel won, and the
+    # runner's exit path releases the reservation (TaskRunner does
+    # exactly this pair)
+    assert s.mark_done(d["_id"]) is None
+    s._release_db(d)
+    s.submit("b", db="draining")
+
+
+# -- crash safety + lease fencing -------------------------------------------
+
+
+def test_scheduler_state_survives_restart():
+    """All state is board documents: a brand-new Scheduler over the
+    same store continues exactly where the dead one stopped."""
+    store = MemoryDocStore()
+    a = Scheduler(store)
+    ids = [a.submit("a", est_jobs=2)["_id"] for _ in range(3)]
+    a.tick()
+    # "crash": drop the object, no teardown
+    a.release()
+    b = Scheduler(store)
+    states = {d["_id"]: d["state"] for d in b.list_tasks()}
+    assert sorted(states) == sorted(ids)
+    assert sum(1 for v in states.values() if v == ADMITTED) == 2
+    assert b.tick() == []  # budget still full — the docs remember
+    for tid, st in states.items():
+        if st == ADMITTED:
+            b.mark_running(tid)
+            b.mark_done(tid, records=5)
+    assert len(b.tick()) == 1
+    snap = b.snapshot()
+    assert snap["tenants"]["a"]["served_records"] == 10
+
+
+def test_admission_lease_fences_deposed_scheduler():
+    import time
+
+    from mapreduce_tpu.sched.scheduler import SchedulerLease, _SchedCnn
+
+    store = MemoryDocStore()
+    a = Scheduler(store,
+                  lease=SchedulerLease(_SchedCnn(store), lease=0.2))
+    a.submit("t")
+    assert len(a.tick()) == 1  # a holds the lease now
+    # b cannot admit while a's lease is live
+    b = Scheduler(store)
+    b.submit("t")
+    assert b.tick() == []
+    # a goes silent past its lease; b claims it (generation bumps)
+    time.sleep(0.3)
+    assert len(b.tick()) == 1
+    # a's next STRICT tick learns the deposition definitively (its
+    # guarded heartbeat matches nothing) and fences loudly ...
+    a.submit("t")
+    with pytest.raises(SchedulerFencedError):
+        a.tick(strict=True)
+    # ... while the default (hosted) mode re-contends quietly: b holds
+    # a LIVE lease, so a cannot re-acquire and admits nothing
+    assert a.tick() == []
+    assert REGISTRY.value("mrtpu_sched_fences_total") >= 1
+
+
+# -- the /tasks HTTP surface -------------------------------------------------
+
+
+def test_tasks_http_submit_list_cancel_and_statusz():
+    srv = DocServer().start_background()
+    try:
+        c = SchedulerClient(f"{srv.host}:{srv.port}")
+        doc = c.submit("alice", est_jobs=3, est_bytes=30)
+        assert doc["state"] == QUEUED
+        with pytest.raises(QuotaExceededError) as ei:
+            c.submit("bob", db=doc["db"])
+        assert ei.value.reason == "db_active"
+        listing = c.list()
+        assert [t["_id"] for t in listing["tasks"]] == [doc["_id"]]
+        assert listing["sched"]["tenants"]["alice"]["queued"] == 1
+        assert listing["sched"]["tenants"]["alice"]["queued_jobs"] == 3
+        assert c.tick()[0]["_id"] == doc["_id"]
+        cancelled = c.cancel(doc["_id"])
+        assert cancelled["state"] == CANCELLED
+        # /statusz carries the sched section from the same snapshot
+        snap = cluster_status(srv.store, collector=srv.collector,
+                              scheduler=srv.scheduler)
+        assert snap["sched"]["tenants"]["alice"]["cancelled"] == 1
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_tasks_mutations_are_rid_deduped():
+    """A retried submit (same rid) must answer from the dedupe cache,
+    not enqueue a second task — the board-mutation contract extended
+    to /tasks."""
+    from mapreduce_tpu.utils.httpclient import KeepAliveClient
+
+    srv = DocServer().start_background()
+    try:
+        cl = KeepAliveClient.from_address(f"{srv.host}:{srv.port}",
+                                          what="test")
+        payload = json.dumps({"op": "submit", "tenant": "dup",
+                              "rid": f"{uuid.uuid4().hex}:1"}).encode()
+        bodies = []
+        for _ in range(3):
+            status, raw = cl.request(
+                "POST", "/tasks", body=payload,
+                headers={"Content-Type": "application/json"})
+            assert status == 200
+            bodies.append(json.loads(raw))
+        assert bodies[0] == bodies[1] == bodies[2]
+        assert srv.store.count(TASKS_COLL, {"tenant": "dup"}) == 1
+        assert REGISTRY.value("mrtpu_docserver_requests_total",
+                              op="tasks:submit", outcome="replayed") >= 2
+        cl.close()
+    finally:
+        srv.shutdown()
+
+
+def test_tasks_surface_is_auth_gated():
+    from mapreduce_tpu.utils.httpclient import KeepAliveClient
+
+    srv = DocServer(auth_token="sekrit").start_background()
+    try:
+        cl = KeepAliveClient.from_address(f"{srv.host}:{srv.port}",
+                                          what="test")
+        status, _ = cl.request("GET", "/tasks")
+        assert status == 401
+        status, _ = cl.request(
+            "POST", "/tasks",
+            body=json.dumps({"op": "submit", "tenant": "x",
+                             "rid": "s:1"}).encode())
+        assert status == 401
+        cl.close()
+        ok = SchedulerClient(f"{srv.host}:{srv.port}",
+                             auth_token="sekrit")
+        assert ok.submit("x")["state"] == QUEUED
+        ok.close()
+    finally:
+        srv.shutdown()
+
+
+# -- end to end through the service layer ------------------------------------
+
+
+def _tenant_params(name, files):
+    sched_mods.reset(name, files)
+    m = f"tests.sched_mod_{name}"
+    params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
+                             "reducefn", "finalfn")}
+    params["storage"] = f"mem:{uuid.uuid4().hex}"
+    return params
+
+
+def _files(tmp_path, name, n=3):
+    out = []
+    for i in range(n):
+        p = tmp_path / f"{name}{i}.txt"
+        p.write_text(f"alpha beta {name}{i} gamma alpha\n" * 4)
+        out.append(str(p))
+    return out
+
+
+def test_one_worker_pool_serves_two_tenants(tmp_path):
+    """The tentpole's serving shape: ONE cross-tenant worker pool plus
+    a runner drains two tenants' tasks submitted through the
+    scheduler; exactly-once per job proven by the witness counters."""
+    srv = DocServer().start_background()
+    runner = pool = None
+    try:
+        connstr = f"http://{srv.host}:{srv.port}"
+        sch = srv.scheduler
+        runner = TaskRunner(connstr, sch).start()
+        pool = spawn_scheduled_workers(connstr, 2)
+        da = sch.submit("alice", db="wa",
+                        params=_tenant_params("a", _files(tmp_path, "a")),
+                        est_jobs=3)
+        db = sch.submit("bob", db="wb",
+                        params=_tenant_params("b", _files(tmp_path, "b")),
+                        est_jobs=3)
+        wait_for_state(sch, da["_id"], DONE, timeout=60)
+        wait_for_state(sch, db["_id"], DONE, timeout=60)
+        for name in ("a", "b"):
+            st = sched_mods.state(name)
+            assert dict(st.COMPLETED) == {0: 1, 1: 1, 2: 1}
+            assert st.RESULT["alpha"] == 24
+            assert st.RESULT[f"{name}0"] == 4
+        snap = sch.snapshot()
+        assert snap["tenants"]["alice"]["done"] == 1
+        assert snap["tenants"]["alice"]["served_records"] > 0
+    finally:
+        if runner:
+            runner.stop()
+        for w in pool or []:
+            w.stop()
+        srv.shutdown()
+
+
+def test_two_servers_one_process_stats_stay_disjoint(tmp_path):
+    """Satellite regression for the server.py db-label hazard: two
+    CONCURRENT tasks on one board, driven by two Server instances in
+    ONE process (the runner's threads), must keep their persisted
+    stats docs and their registry stats series disjoint — each doc
+    counts exactly its own jobs, and the doc equals the registry
+    read-back for its own db (doc ≡ /metrics by construction, per
+    db).  Routing through the scheduler is what also guarantees the
+    precondition db labels cannot enforce: no two tasks share a db
+    (test_duplicate_active_db_rejected)."""
+    srv = DocServer().start_background()
+    runner = pool = None
+    try:
+        connstr = f"http://{srv.host}:{srv.port}"
+        sch = srv.scheduler
+        runner = TaskRunner(connstr, sch).start()
+        pool = spawn_scheduled_workers(connstr, 2)
+        # different job counts per tenant so cross-contamination cannot
+        # hide behind symmetry
+        da = sch.submit("alice", db="dja",
+                        params=_tenant_params("a",
+                                              _files(tmp_path, "a", 4)),
+                        est_jobs=4)
+        db = sch.submit("bob", db="djb",
+                        params=_tenant_params("b",
+                                              _files(tmp_path, "b", 2)),
+                        est_jobs=2)
+        wait_for_state(sch, da["_id"], DONE, timeout=60)
+        wait_for_state(sch, db["_id"], DONE, timeout=60)
+        docs = {}
+        for dbname, n_map in (("dja", 4), ("djb", 2)):
+            found = srv.store.find(f"{dbname}.task", {"_id": "unique"})
+            assert found, f"no task doc for {dbname}"
+            stats = found[0]["stats"]
+            docs[dbname] = stats
+            # the doc counts exactly its OWN jobs
+            assert stats["map"]["count"] == n_map, (dbname, stats)
+            assert stats["map"]["failed"] == 0
+            # and equals the registry read-back for its own db label
+            assert int(REGISTRY.value("mrtpu_stats_jobs", db=dbname,
+                                      phase="map", state="all")) == n_map
+        assert docs["dja"] != docs["djb"]
+    finally:
+        if runner:
+            runner.stop()
+        for w in pool or []:
+            w.stop()
+        srv.shutdown()
+
+
+def test_runner_stops_loudly_on_auth_rejection():
+    """An auth-misconfigured runner must stop and surface the
+    PermissionError (retrying at poll cadence never heals it), the
+    same carve-out the worker loop already has."""
+    import time
+
+    srv = DocServer(auth_token="sekrit").start_background()
+    try:
+        from mapreduce_tpu.coord import docstore
+
+        store = docstore.connect(f"http://{srv.host}:{srv.port}")  # no auth
+        runner = TaskRunner(f"http://{srv.host}:{srv.port}",
+                            Scheduler(store), poll=0.02).start()
+        give_up = time.monotonic() + 10
+        while time.monotonic() < give_up and runner.failed is None:
+            time.sleep(0.02)
+        assert isinstance(runner.failed, PermissionError)
+        assert runner._stop.is_set()
+        runner.stop()
+    finally:
+        srv.shutdown()
+
+
+def test_scheduled_worker_skips_session_tasks():
+    """kind="session" tasks are served by a resident engine session,
+    not the host worker pool: the pool's active-task query must not
+    spin a Worker up for them."""
+    store = MemoryDocStore()
+    s = Scheduler(store)
+    s.submit("t", kind="session")
+    s.tick()
+    w = ScheduledWorker("mem://unused-board")
+    w._store = store
+    assert w._active_tasks() == []

@@ -1,0 +1,283 @@
+"""Golden-equivalence suite for the resident engine session + top-K.
+
+The acceptance contract: ``EngineSession.snapshot()`` MID-STREAM equals
+a from-scratch batch ``DeviceEngine.run`` over the same records,
+bit-for-bit, for sum/min/max — the integer monoids the fused fold
+carries are exact, so how the stream was cut into feeds cannot show in
+the aggregate.  Plus: task multiplexing isolation (waves of tenant A
+never touch tenant B's accumulator), the one-dispatch-per-wave
+execution model with the session layer active, the no-replay overflow
+contract, and the top-K workload's host-plane golden."""
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.engine import DeviceEngine, EngineConfig
+from mapreduce_tpu.engine.session import EngineSession, SessionOverflowError
+from mapreduce_tpu.engine.topk import (
+    TopKWords, host_topk, topk_bytes)
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.parallel import make_mesh
+
+from tests.test_fused_engine import (
+    _chunks, _dict_oracle, _records_map_fn, _result_dict)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _cfg(op):
+    return EngineConfig(local_capacity=256, exchange_capacity=128,
+                        out_capacity=256, tile=64, tile_records=64,
+                        reduce_op=op)
+
+
+def _assert_bit_identical(snap, res):
+    """Full-array equality over the common readback width (each side
+    slices its capacity-padded result to its own live max)."""
+    for field in range(4):
+        a, b = np.asarray(snap[field]), np.asarray(res[field])
+        w = min(a.shape[1], b.shape[1])
+        assert np.array_equal(a[:, :w], b[:, :w]), snap._fields[field]
+        # anything beyond the common width must be dead rows
+        assert not np.asarray(snap.valid)[:, w:].any()
+        assert not np.asarray(res.valid)[:, w:].any()
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max"])
+def test_snapshot_mid_stream_equals_batch_run(mesh, op):
+    """Feed in three uneven slices, snapshot after each; every
+    snapshot is bit-identical to a from-scratch batch run over exactly
+    the records fed so far."""
+    n_dev = mesh.shape["data"]
+    K = 2
+    rng = np.random.default_rng(7)
+    chunks = _chunks(rng, 3 * K * n_dev)
+    cuts = [K * n_dev, 2 * K * n_dev, 3 * K * n_dev]
+
+    sess = EngineSession(mesh, _records_map_fn, _cfg(op), k=K)
+    fed = 0
+    for cut in cuts:
+        sess.feed(chunks[fed:cut], task="t")
+        fed = cut
+        snap = sess.snapshot("t")
+        batch = DeviceEngine(mesh, _records_map_fn, _cfg(op))
+        res = batch.run(chunks[:cut], waves=cut // (K * n_dev))
+        _assert_bit_identical(snap, res)
+        assert snap.overflow == 0 and res.overflow == 0
+        assert _result_dict(snap) == _dict_oracle(chunks[:cut], op)
+
+
+def test_snapshot_does_not_stop_the_stream(mesh):
+    """The continuous-query contract: a snapshot is a read, not a
+    barrier — feeding continues afterwards and the next snapshot
+    reflects both epochs."""
+    n_dev = mesh.shape["data"]
+    rng = np.random.default_rng(8)
+    chunks = _chunks(rng, 2 * n_dev)
+    sess = EngineSession(mesh, _records_map_fn, _cfg("sum"), k=1)
+    sess.feed(chunks[:n_dev], task="t")
+    first = _result_dict(sess.snapshot("t"))
+    assert first == _dict_oracle(chunks[:n_dev], "sum")
+    sess.feed(chunks[n_dev:], task="t")
+    assert _result_dict(sess.snapshot("t")) == _dict_oracle(chunks,
+                                                            "sum")
+    assert sess.stats("t") == {"chunks": 2 * n_dev, "waves": 2,
+                               "feeds": 2, "overflow": 0}
+
+
+def test_tasks_multiplex_without_mixing(mesh):
+    """Two tenants interleave waves over ONE session (one mesh, one
+    compiled program): each snapshot sees exactly its own records."""
+    n_dev = mesh.shape["data"]
+    rng = np.random.default_rng(9)
+    ca = _chunks(rng, 2 * n_dev)
+    cb = _chunks(rng, 2 * n_dev)
+    sess = EngineSession(mesh, _records_map_fn, _cfg("sum"), k=1)
+    sess.feed(ca[:n_dev], task="a")
+    sess.feed(cb[:n_dev], task="b")
+    sess.feed(ca[n_dev:], task="a")
+    sess.feed(cb[n_dev:], task="b")
+    assert _result_dict(sess.snapshot("a")) == _dict_oracle(ca, "sum")
+    assert _result_dict(sess.snapshot("b")) == _dict_oracle(cb, "sum")
+    assert sorted(sess.tasks()) == ["a", "b"]
+    sess.close("a")
+    assert sess.tasks() == ["b"]
+    with pytest.raises(KeyError):
+        sess.snapshot("a")
+
+
+def test_session_one_dispatch_per_wave_and_program_reuse(mesh):
+    """The fused execution model holds under the session layer: every
+    session wave is exactly one wave-program dispatch (no merge
+    program exists to dispatch), asserted from the registry like the
+    bench smoke; and the N-th feed compiles nothing new."""
+    n_dev = mesh.shape["data"]
+    rng = np.random.default_rng(10)
+    chunks = _chunks(rng, 4 * n_dev)
+    sess = EngineSession(mesh, _records_map_fn, _cfg("sum"), k=1)
+    sess.feed(chunks[:n_dev], task="t")  # first feed: compile happens
+    d0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    obs0 = REGISTRY.value("mrtpu_compile_seconds", program="wave",
+                          stage="backend_compile")
+    sess.feed(chunks[n_dev:], task="t")  # 3 more waves
+    dispatched = (REGISTRY.sum("mrtpu_device_dispatches_total",
+                               program="wave") - d0)
+    assert dispatched == 3
+    assert REGISTRY.sum("mrtpu_device_dispatches_total",
+                        program="merge") == 0
+    assert REGISTRY.value("mrtpu_compile_seconds", program="wave",
+                          stage="backend_compile") == obs0, (
+        "a steady-state session feed recompiled the wave program")
+
+
+def test_session_overflow_raises_and_counts(mesh):
+    """No-replay contract: overflow is surfaced (counted + raised),
+    never silently truncated; on_overflow="count" keeps streaming with
+    the loss visible in the snapshot."""
+    n_dev = mesh.shape["data"]
+    rng = np.random.default_rng(11)
+    chunks = _chunks(rng, n_dev, r=256)
+    tiny = EngineConfig(local_capacity=8, exchange_capacity=4,
+                        out_capacity=8, tile=64, tile_records=64,
+                        reduce_op="sum")
+    sess = EngineSession(mesh, _records_map_fn, tiny, k=1)
+    with pytest.raises(SessionOverflowError):
+        sess.feed(chunks, task="t")
+    lost = sess.feed(chunks, task="t2", on_overflow="count")
+    assert lost > 0
+    assert sess.snapshot("t2").overflow == lost
+    assert REGISTRY.sum("mrtpu_session_overflow_rows_total",
+                        task="t2") == lost
+
+
+def test_feed_dying_mid_wave_poisons_the_stream(mesh):
+    """A dispatch failure mid-feed leaves the accumulator between
+    states (some waves folded, pos not advanced, buffers possibly
+    donated away): the stream must POISON — a retried feed or a
+    snapshot raises SessionStreamBroken instead of double-counting or
+    reading invalidated buffers — and close(task) restarts clean."""
+    from mapreduce_tpu.engine.session import SessionStreamBroken
+
+    n_dev = mesh.shape["data"]
+    rng = np.random.default_rng(13)
+    chunks = _chunks(rng, 3 * n_dev)
+    sess = EngineSession(mesh, _records_map_fn, _cfg("sum"), k=1)
+    sess.feed(chunks[:n_dev], task="t")  # healthy first feed
+    real_fn = sess.engine._get_compiled(sess.config)
+    calls = {"n": 0}
+
+    def dying(*args):
+        calls["n"] += 1
+        if calls["n"] == 2:  # die on the SECOND wave of the next feed
+            raise RuntimeError("injected dispatch failure")
+        return real_fn(*args)
+
+    sess.engine._compiled[sess.config.cache_key()] = dying
+    with pytest.raises(RuntimeError, match="injected"):
+        sess.feed(chunks[n_dev:], task="t")
+    sess.engine._compiled[sess.config.cache_key()] = real_fn
+    with pytest.raises(SessionStreamBroken):
+        sess.feed(chunks[n_dev:], task="t")  # retry must NOT fold again
+    with pytest.raises(SessionStreamBroken):
+        sess.snapshot("t")
+    # other streams are unaffected; a closed stream restarts clean
+    sess.feed(chunks, task="fresh")
+    assert _result_dict(sess.snapshot("fresh")) == _dict_oracle(chunks,
+                                                                "sum")
+    sess.close("t")
+    sess.feed(chunks, task="t")
+    assert _result_dict(sess.snapshot("t")) == _dict_oracle(chunks,
+                                                            "sum")
+
+
+def test_session_row_shape_is_latched(mesh):
+    n_dev = mesh.shape["data"]
+    rng = np.random.default_rng(12)
+    sess = EngineSession(mesh, _records_map_fn, _cfg("sum"), k=1)
+    sess.feed(_chunks(rng, n_dev), task="t")
+    with pytest.raises(ValueError):
+        sess.feed(_chunks(rng, n_dev, r=64), task="t")
+
+
+# -- top-K heavy hitters -----------------------------------------------------
+
+
+_CORPUS_A = b"apple banana apple cherry apple banana date elder " * 40
+_CORPUS_B = b"cherry cherry elder apple fig grape grape " * 25
+
+
+def test_topk_streaming_matches_host_golden(mesh):
+    tk = TopKWords(mesh, k=4, chunk_len=512)
+    tk.feed(_CORPUS_A)
+    assert tk.topk() == host_topk(_CORPUS_A, 4)
+    tk.feed(_CORPUS_B)  # the stream continues across feeds
+    assert tk.topk() == host_topk(_CORPUS_A + b" " + _CORPUS_B, 4)
+    st = tk.stats()
+    assert st["overflow"] == 0 and st["feeds"] == 2
+    assert st["bytes_fed"] == len(_CORPUS_A) + len(_CORPUS_B)
+
+
+def test_topk_non_tile_multiple_chunk_len(mesh):
+    """shard_text rounds the padded row width up to a tile multiple —
+    materialisation must use the width it actually produced, not the
+    requested one, or every word past row 0 garbles silently."""
+    tk = TopKWords(mesh, k=3, chunk_len=1000)  # row rounds 1512 -> 1536
+    tk.feed(_CORPUS_A)
+    tk.feed(_CORPUS_B)
+    assert tk._L is not None and tk._L % tk.config.tile == 0
+    assert tk.topk() == host_topk(_CORPUS_A + b" " + _CORPUS_B, 3)
+
+
+def test_topk_materializing_stream_refuses_int32_offset_wrap(mesh):
+    """The device payload offset is int32: a materialising stream
+    whose global byte offsets would wrap must refuse LOUDLY (garbled
+    words with real counts would be silent corruption); hash-only
+    streams are unaffected."""
+    tk = TopKWords(mesh, k=2, chunk_len=512)
+    tk.feed(_CORPUS_A)
+    tk._L = 2 ** 30  # simulate a stream ~2 GiB in
+    with pytest.raises(OverflowError, match="int32"):
+        tk.feed(_CORPUS_A)
+    nk = TopKWords(mesh, k=2, chunk_len=512, materialize=False)
+    nk.feed(_CORPUS_A)
+    nk._L = 2 ** 30
+    nk.feed(_CORPUS_A)  # hash-only: unbounded by design
+
+
+def test_topk_tie_break_is_deterministic(mesh):
+    """Equal counts at the K boundary resolve lexicographically — the
+    same contract host_topk pins — so the cut cannot flap."""
+    corpus = b"zeta alpha mid mid " * 10  # zeta == alpha == 10, mid 20
+    tk = TopKWords(mesh, k=2, chunk_len=512)
+    tk.feed(corpus)
+    assert tk.topk() == [(b"mid", 20), (b"alpha", 10)]
+
+
+def test_topk_batch_rides_capacity_retry(mesh):
+    """The batch form uses the engine's full right-size-and-retry
+    machinery: absurd starting capacities still converge to the host
+    golden (retries recorded in the registry)."""
+    tiny = EngineConfig(local_capacity=64, exchange_capacity=32,
+                        out_capacity=64, tile=512, tile_records=16,
+                        combine_in_scan=True, combine_capacity=16,
+                        unit_values=True, reduce_op="sum")
+    r0 = REGISTRY.sum("mrtpu_device_retries_total")
+    got = topk_bytes(mesh, _CORPUS_A, k=3, chunk_len=512, config=tiny)
+    assert got == host_topk(_CORPUS_A, 3)
+    assert REGISTRY.sum("mrtpu_device_retries_total") > r0, (
+        "tiny capacities never retried — the scenario tested nothing")
+
+
+def test_topk_hash_only_mode(mesh):
+    """materialize=False retains no host bytes: counts still exact,
+    words unresolved (None) — the unbounded-stream mode."""
+    tk = TopKWords(mesh, k=3, chunk_len=512, materialize=False)
+    tk.feed(_CORPUS_A)
+    got = tk.topk()
+    want = host_topk(_CORPUS_A, 3)
+    assert [c for _w, c in got] == [c for _w, c in want]
+    assert all(w is None for w, _c in got)
+    assert tk._chunks == []
