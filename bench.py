@@ -109,6 +109,21 @@ def gate_specs():
         # measurement and a real TPU raises the bar as it appends.
         MetricSpec("sustained_records_per_s", rel_tol=0.90,
                    direction="higher", required=True),
+        # the serving-SLO plane (obs/slo): submit -> first-snapshot and
+        # snapshot-staleness p99 under the same sustained-churn
+        # harness, estimated from the per-tenant SLO histogram bucket
+        # counts (obs/metrics.estimate_percentile) — the latency half
+        # of the serving gate next to the throughput key above.  Both
+        # REQUIRED (a run that stops reporting them fails loudly);
+        # tolerances are VERY wide (one order of magnitude) because the
+        # history mixes platforms AND scales and the bucket ladder
+        # quantizes log-spaced (~2.5x per rung): the gate exists to
+        # catch a serving path that got qualitatively slower, not to
+        # police a rung.
+        MetricSpec("submit_first_snapshot_p99_s", rel_tol=9.0,
+                   required=True),
+        MetricSpec("snapshot_staleness_p99_s", rel_tol=9.0,
+                   required=True),
     ]
 VOCAB = 80_000
 N_PUNCT_VOCAB = 10_000       # vocab entries that are word+punctuation
@@ -297,7 +312,16 @@ def measure_sustained(mesh, smoke: bool) -> dict:
     Pre-chunked inputs and a pre-warmed program keep the number the
     SERVING rate (upload + fused dispatch + overflow readback), not a
     text-splitting or compile benchmark — matching the main bench's
-    clock semantics (corpus staged, compile excluded)."""
+    clock semantics (corpus staged, compile excluded).
+
+    The serving-SLO keys ride the same harness: each tenant's
+    submit→first-snapshot is measured from its scheduler submit stamp
+    to its first consistent snapshot, and snapshot staleness is
+    sampled at every snapshot the harness takes; the gated p99s are
+    estimated from the per-tenant SLO histogram bucket counts
+    (obs/metrics.estimate_percentile — the same estimator the /statusz
+    SLO section uses), over exactly this run's observations (bucket
+    deltas against a baseline captured before the first submit)."""
     import threading
 
     import jax  # noqa: F401  (the session dispatches engine programs)
@@ -307,6 +331,8 @@ def measure_sustained(mesh, smoke: bool) -> dict:
     from mapreduce_tpu.engine.session import EngineSession
     from mapreduce_tpu.engine.topk import TopKWords
     from mapreduce_tpu.engine.wordcount import wordcount_map_fn
+    from mapreduce_tpu.obs import slo as slo_mod
+    from mapreduce_tpu.obs.metrics import estimate_percentile
     from mapreduce_tpu.ops.tokenize import shard_text
     from mapreduce_tpu.sched.scheduler import (
         Scheduler, SchedulerConfig)
@@ -333,10 +359,6 @@ def measure_sustained(mesh, smoke: bool) -> dict:
     scheduler = Scheduler(MemoryDocStore(),
                           config=SchedulerConfig(
                               max_inflight=len(tenants) + 1))
-    for t in tenants:
-        scheduler.submit(t, db=f"sess_{t}", kind="session",
-                         est_jobs=rounds)
-    scheduler.tick()
 
     # one corpus slice, pre-chunked; every (tenant, round) feeds a copy
     # (streams accumulate counts, so re-feeding the same block is a
@@ -355,7 +377,25 @@ def measure_sustained(mesh, smoke: bool) -> dict:
                            -(-len(chunks) // eng.n_dev)))
     session.feed(chunks[: min(len(chunks),
                               session.engine.n_dev)], task="warm")
-    session.close("warm")  # program compiled; drop the warm stream
+    session.snapshot("warm")  # warm the snapshot/readback path too:
+    # the first-result phase measures the SERVING path, not a compile
+    session.close("warm")  # programs compiled; drop the warm stream
+
+    def _snap_total(t) -> int:
+        snap = session.snapshot(t)
+        assert snap.overflow == 0, (
+            f"sustained stream {t} overflowed {snap.overflow} rows — "
+            "size the config up, the number would be a lie")
+        vals = np.asarray(snap.values).reshape(-1)
+        valid = np.asarray(snap.valid).reshape(-1)
+        return int(vals[valid.nonzero()[0]].sum())
+
+    # SLO baseline: bucket counts BEFORE the first submit, so the gated
+    # p99s are estimated from exactly this run's observations
+    slo_bounds, sub_base = slo_mod.merged_counts(
+        slo_mod.FIRST_RESULT_FAMILY, tenants)
+    _, stale_base = slo_mod.merged_counts(
+        slo_mod.STALENESS_FAMILY, tenants)
 
     churn_stop = threading.Event()
     churn_counts = {"submitted": 0, "cancelled": 0}
@@ -374,27 +414,64 @@ def measure_sustained(mesh, smoke: bool) -> dict:
 
     churn_t = threading.Thread(target=_churn, daemon=True)
     churn_t.start()
+
+    # phase 1 — submit -> first snapshot, per tenant: the scheduler
+    # submit stamps the monotonic start (obs/slo), the first consistent
+    # snapshot is the first visible result.  The program is pre-warmed,
+    # so this measures the SERVING path, not a compile.
+    first_result = {}
+    before = {}
+    for t in tenants:
+        doc = scheduler.submit(t, db=f"sess_{t}", kind="session",
+                               est_jobs=rounds)
+        scheduler.tick()
+        session.feed(chunks, task=t)
+        before[t] = _snap_total(t)   # staleness sampled here too
+        first_result[t] = slo_mod.observe_first_result(doc["_id"], t)
+
+    # phase 2 — the timed sustained window (feeds only, the gated rate)
     t0 = time.monotonic()
     for _r in range(rounds):
         for t in tenants:
             session.feed(chunks, task=t)
     wall = time.monotonic() - t0
+
+    # phase 3 — staleness sampling under multiplexing: snapshot every
+    # tenant right after the window (tenant 0 is then the stalest —
+    # every later tenant's feed aged its aggregate); with phase 1's
+    # post-feed snapshots that is two staleness samples per tenant,
+    # spanning the fresh and the multiplexed-aged cases
+    after = {t: _snap_total(t) for t in tenants}
     churn_stop.set()
     churn_t.join(timeout=5)
 
     records = 0
     waves = 0
     for t in tenants:
-        snap = session.snapshot(t)
-        assert snap.overflow == 0, (
-            f"sustained stream {t} overflowed {snap.overflow} rows — "
-            "size the config up, the number would be a lie")
-        vals = np.asarray(snap.values).reshape(-1)
-        valid = np.asarray(snap.valid).reshape(-1)
-        n = int(vals[valid.nonzero()[0]].sum())
-        records += n
+        records += after[t] - before[t]
         waves += session.stats(t)["waves"]
-        scheduler.note_served(t, n)
+        scheduler.note_served(t, after[t])
+
+    # the gated SLO keys: p50/p99 estimated from this run's bucket
+    # deltas (the same estimator the /statusz SLO section rides)
+    _, sub_now = slo_mod.merged_counts(slo_mod.FIRST_RESULT_FAMILY,
+                                       tenants)
+    _, stale_now = slo_mod.merged_counts(slo_mod.STALENESS_FAMILY,
+                                         tenants)
+    sub_counts = [b - a for a, b in zip(sub_base, sub_now)]
+    stale_counts = [b - a for a, b in zip(stale_base, stale_now)]
+    slo_keys = {
+        "submit_first_snapshot_p99_s": estimate_percentile(
+            slo_bounds, sub_counts, 0.99),
+        "submit_first_snapshot_p50_s": estimate_percentile(
+            slo_bounds, sub_counts, 0.50),
+        "snapshot_staleness_p99_s": estimate_percentile(
+            slo_bounds, stale_counts, 0.99),
+        "snapshot_staleness_p50_s": estimate_percentile(
+            slo_bounds, stale_counts, 0.50),
+    }
+    slo_keys = {k: (None if v is None else round(v, 4))
+                for k, v in slo_keys.items()}
 
     # the top-K bench entry: a streaming TopKWords over one slice, the
     # mid-stream snapshot+selection timed (the bounded-output read the
@@ -417,6 +494,12 @@ def measure_sustained(mesh, smoke: bool) -> dict:
         "sustained_churn_cancelled": churn_counts["cancelled"],
         "topk_k": len(top),
         "topk_snapshot_s": round(topk_s, 4),
+        # the gated serving-SLO keys (obs/slo) + context: per-tenant
+        # measured submit->first-snapshot seconds for the record
+        "submit_first_snapshot_s": {
+            t: (None if s is None else round(s, 4))
+            for t, s in first_result.items()},
+        **slo_keys,
     }
 
 
@@ -589,6 +672,24 @@ def check_smoke() -> int:
     assert any(benchgate.lookup(h, "sustained_records_per_s") is not None
                for h in history), (
         "no BENCH.json history entry carries 'sustained_records_per_s'")
+    # the serving-SLO gate (obs/slo): both gated latency keys must be
+    # present in the run's timings AND seeded in history — presence
+    # only, zero wall-clock comparisons (the values are real latencies
+    # of this host and would flake under load)
+    for key in ("submit_first_snapshot_p99_s",
+                "snapshot_staleness_p99_s"):
+        assert benchgate.lookup(sustained, key) is not None, (
+            f"measure_sustained stopped reporting gated SLO key {key!r}")
+        assert any(benchgate.lookup(h, key) is not None
+                   for h in history), (
+            f"no BENCH.json history entry carries {key!r}")
+    # every sustained tenant produced SLO observations (first-result
+    # once per stream, staleness at each snapshot)
+    for t in ("t0", "t1", "t2"):
+        assert REGISTRY.value("mrtpu_slo_submit_first_result_seconds",
+                              tenant=t) >= 1, t
+        assert REGISTRY.value("mrtpu_slo_snapshot_staleness_seconds",
+                              tenant=t) >= 2, t
 
     from mapreduce_tpu.engine.session import EngineSession
     from mapreduce_tpu.engine.wordcount import wordcount_map_fn
@@ -666,6 +767,10 @@ def check_smoke() -> int:
         "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
         "second_build_cached": cached_delta,
         "sustained_records_per_s": sustained["sustained_records_per_s"],
+        "submit_first_snapshot_p99_s":
+            sustained["submit_first_snapshot_p99_s"],
+        "snapshot_staleness_p99_s":
+            sustained["snapshot_staleness_p99_s"],
         "session_dispatches_per_wave": sess_disp / sess_waves,
         "exchange_records": tm["exchange_records"],
         "exchange_imbalance": tm["exchange_imbalance"],
@@ -848,7 +953,11 @@ def main() -> None:
           f"{sustained['sustained_records_per_s']} over "
           f"{sustained['sustained_waves']} waves, churn "
           f"{sustained['sustained_churn_submitted']} submits / "
-          f"{sustained['sustained_churn_cancelled']} cancels",
+          f"{sustained['sustained_churn_cancelled']} cancels; "
+          f"submit_first_snapshot_p99_s="
+          f"{sustained['submit_first_snapshot_p99_s']} "
+          f"snapshot_staleness_p99_s="
+          f"{sustained['snapshot_staleness_p99_s']}",
           file=sys.stderr, flush=True)
 
     result = {

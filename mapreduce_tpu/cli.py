@@ -578,6 +578,25 @@ def cmd_blobserver(argv: List[str]) -> int:
     return 0
 
 
+def _add_slo(p) -> None:
+    p.add_argument(
+        "--slo", action="append", default=None, metavar="SPEC",
+        help="serving-SLO objective NAME:pPCT:THRESHOLD[:LONG_S"
+             "[:SHORT_S]] (repeatable; replaces the defaults).  NAME is "
+             "one of submit_first_result / snapshot_staleness / "
+             "queue_wait; e.g. --slo snapshot_staleness:p99:1.0:600:60")
+
+
+def _setup_slo(args) -> None:
+    """Apply the --slo flags to the process-global SLO plane (obs/slo);
+    no flags = keep the documented defaults."""
+    if not getattr(args, "slo", None):
+        return
+    from .obs import slo as slo_mod
+
+    slo_mod.configure([slo_mod.parse_objective(s) for s in args.slo])
+
+
 def cmd_docserver(argv: List[str]) -> int:
     """Serve the control plane (job board) over HTTP — the mongod role.
     Workers and servers on any machine connect with ``http://HOST:PORT``
@@ -598,10 +617,12 @@ def cmd_docserver(argv: List[str]) -> int:
     g.add_argument("--tenant-max-queued-tasks", type=int, default=None)
     g.add_argument("--tenant-max-queued-jobs", type=int, default=None)
     g.add_argument("--tenant-max-queued-bytes", type=int, default=None)
+    _add_slo(p)
     _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
+    _setup_slo(args)
 
     from .coord.docserver import DocServer
     from .coord.docstore import DirDocStore
@@ -794,12 +815,43 @@ def _render_sched(sched: dict) -> List[str]:
             f"{s}={row.get(s, 0)}"
             for s in ("queued", "admitted", "running", "done",
                       "cancelled", "failed") if row.get(s))
+        age = row.get("oldest_queued_age_s")
         lines.append(
             "  tenant {}: {}  | queued work {} jobs / {} B | "
-            "{} records served".format(
+            "{} records served{}".format(
                 t, active or "idle", row.get("queued_jobs", 0),
                 row.get("queued_bytes", 0),
-                row.get("served_records", 0)))
+                row.get("served_records", 0),
+                "" if age is None
+                else f" | oldest queued {age:.1f}s"))
+    return lines
+
+
+def _render_slo(slo: dict) -> List[str]:
+    """The serving-SLO section of /statusz (obs/slo): per-tenant
+    objective percentiles, burn rates and breach state against the
+    configured targets."""
+    if not slo or not slo.get("tenants"):
+        return []
+    objectives = {o["name"]: o for o in slo.get("objectives") or []}
+    lines = ["serving SLOs ({}):".format("  ".join(
+        "{} {}<{:g}s/{:g}s+{:g}s".format(
+            o["name"], o.get("pct", "p99"), o["threshold_s"],
+            o["long_window_s"], o["short_window_s"])
+        for o in (slo.get("objectives") or [])))]
+    for tenant, objs in sorted(slo["tenants"].items()):
+        for oname, e in sorted(objs.items()):
+            pct = objectives.get(oname, {}).get("pct", "p99")
+            p = e.get("p")
+            lines.append(
+                "  tenant {} {} {}: {} ({} obs, window {})  "
+                "burn {:.1f}x/{:.1f}x  budget {:.0%}{}".format(
+                    tenant, pct, oname,
+                    "-" if p is None else f"{p:.4g}s",
+                    e.get("n", 0), e.get("window_n", 0),
+                    e.get("burn_short", 0.0), e.get("burn_long", 0.0),
+                    e.get("budget_remaining", 1.0),
+                    "  BREACHING" if e.get("breaching") else ""))
     return lines
 
 
@@ -869,6 +921,7 @@ def render_status(snap: dict) -> str:
     lines += _render_comms(snap.get("comms") or {})
     lines += _render_checkpoint(snap.get("checkpoint") or {})
     lines += _render_sched(snap.get("sched") or {})
+    lines += _render_slo(snap.get("slo") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
     tasks = snap.get("tasks", {})
     if not tasks:
@@ -1354,6 +1407,16 @@ def cmd_runner(argv: List[str]) -> int:
     p.add_argument("--max-inflight", type=int, default=2,
                    help="tasks admitted+running at once")
     p.add_argument("--job-lease", type=float, default=None, metavar="S")
+    p.add_argument("--telemetry-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="push span/metric batches to the board's "
+                        "collector every S seconds (0 disables; http "
+                        "boards only).  The SLO lifecycle histograms "
+                        "(queue wait, submit->first result) live in "
+                        "THIS process — pushing them is what makes the "
+                        "docserver's /statusz slo section non-empty in "
+                        "the split docserver/runner deployment")
+    _add_slo(p)
     _add_auth(p)
     _add_retry(p)
     _add_compile_cache(p)
@@ -1361,17 +1424,31 @@ def cmd_runner(argv: List[str]) -> int:
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
+    _setup_slo(args)
     rec = _setup_trace(args)
     _setup_compile_cache(args)
 
     from .coord import docstore
+    from .obs.collector import acquire_pusher, release_pusher
     from .sched.scheduler import Scheduler, SchedulerConfig
     from .sched.service import TaskRunner, spawn_scheduled_workers
+    from .utils.httpclient import default_auth_token, split_embedded_token
 
     retry = _retry_policy(args)
     store = docstore.connect(args.connstr, auth=args.auth, retry=retry)
     scheduler = Scheduler(
         store, config=SchedulerConfig(max_inflight=args.max_inflight))
+    # normalized HOST:PORT (the one embedded-token parser): a TOKEN@
+    # connstr must key the SAME shared pusher the pool's workers use,
+    # never a second one under a token-bearing address string
+    board, embedded = None, None
+    if args.connstr.startswith("http://"):
+        embedded, board = split_embedded_token(
+            args.connstr[len("http://"):])
+    tele = acquire_pusher(board,
+                          default_auth_token(args.auth or embedded),
+                          role="runner",
+                          interval=args.telemetry_interval)
     runner = TaskRunner(args.connstr, scheduler, auth=args.auth,
                         retry=retry, job_lease=args.job_lease).start()
     pool = spawn_scheduled_workers(args.connstr, args.workers,
@@ -1399,6 +1476,7 @@ def cmd_runner(argv: List[str]) -> int:
         runner.stop()
         for w in pool:
             w.stop()
+        release_pusher(tele)
     _export_trace(args, rec)
     return rc
 

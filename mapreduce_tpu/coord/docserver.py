@@ -366,12 +366,27 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         try:
             if self.path == "/metrics":
                 update_board_gauges(self.store)
+                # SLO gauges (percentile/burn/threshold) are published
+                # by evaluation ticks; run one at scrape time so the
+                # exposition is current (the board-gauge pattern) and
+                # the burn windows sample at scrape cadence
+                from ..obs import slo as _slo
+
+                _slo.evaluate(collector=self.collector)
                 body = _metrics.REGISTRY.render().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif self.path == "/tracez":
                 body = json.dumps(TRACER.chrome_trace()).encode()
                 ctype = "application/json"
             elif self.path == "/clusterz":
+                # evaluate HERE too: `cli diagnose` may be the first
+                # scrape a board ever serves, and _slo_findings reads
+                # the derived percentile/burn/threshold gauges this
+                # tick publishes — without it a breach the runner's
+                # pushed histograms prove would go unnamed
+                from ..obs import slo as _slo
+
+                _slo.evaluate(collector=self.collector)
                 body = json.dumps(self.collector.cluster_doc(),
                                   default=float).encode()
                 ctype = "application/json"

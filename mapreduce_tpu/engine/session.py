@@ -37,11 +37,13 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Optional
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs import metrics as _obs
+from ..obs import slo as _slo
 from ..utils.jax_compat import quiet_unusable_donation
 from .device_engine import (
     AXIS, DeviceEngine, DeviceResult, EngineConfig, _DISPATCHES, _WAVES)
@@ -73,6 +75,56 @@ _OVERFLOWS = _obs.counter(
     "mrtpu_session_overflow_rows_total",
     "rows a session stream dropped for capacity (labels: task); any "
     "nonzero value means that stream's aggregate is truncated")
+_STREAM_AGE = _obs.gauge(
+    "mrtpu_session_stream_age_seconds",
+    "seconds since a resident stream's last feed / last snapshot "
+    "(labels: task, stamp=feed|snapshot), refreshed whole-family on "
+    "every session call AND at each SLO evaluation tick — the "
+    "silent-staleness guard: a stalled stream is visible on /statusz "
+    "even when nobody is polling snapshots (which is exactly when the "
+    "staleness histogram goes quiet)")
+
+#: live sessions, for the whole-family stream-age refresh (weak: a
+#: dropped session's streams must vanish from the gauge, not linger)
+_SESSIONS: "weakref.WeakSet[EngineSession]" = weakref.WeakSet()
+#: last harvested (task, stamp, monotonic) rows per session: a session
+#: whose lock is busy at refresh time contributes its previous stamps
+#: instead of silently vanishing from the whole-family swap (ages keep
+#: counting up from the cached stamps, which is exactly right — the
+#: busy session hasn't completed a call since they were taken)
+_AGE_STAMPS: "weakref.WeakKeyDictionary[EngineSession, list]" = \
+    weakref.WeakKeyDictionary()
+
+
+def refresh_stream_age_gauges(now: Optional[float] = None) -> None:
+    """Swap the whole ``mrtpu_session_stream_age_seconds`` family from
+    every live session's stream stamps (called after each feed/snapshot
+    and from ``obs.slo.evaluate`` — never while holding a session lock)."""
+    now = time.monotonic() if now is None else now
+    rows: List[Tuple[Dict[str, str], float]] = []
+    for sess in list(_SESSIONS):
+        # non-blocking: a session mid-feed holds its lock for the whole
+        # dispatch loop — stalling another session's epilogue (or an
+        # SLO scrape) on it for seconds would serialize independent
+        # streams.  A busy session's CACHED stamps stand in until its
+        # call completes and refreshes them.
+        if sess._lock.acquire(blocking=False):
+            try:
+                stamps = []
+                for task, st in sess._streams.items():
+                    if st.last_feed_monotonic is not None:
+                        stamps.append((task, "feed",
+                                       st.last_feed_monotonic))
+                    if st.last_snapshot_monotonic is not None:
+                        stamps.append((task, "snapshot",
+                                       st.last_snapshot_monotonic))
+                _AGE_STAMPS[sess] = stamps
+            finally:
+                sess._lock.release()
+        for task, stamp, t in _AGE_STAMPS.get(sess, []):
+            rows.append(({"task": task, "stamp": stamp},
+                         round(now - t, 6)))
+    _STREAM_AGE.replace(rows)
 
 
 class SessionOverflowError(RuntimeError):
@@ -98,7 +150,8 @@ class _Stream:
     counters.  ``pos`` is the global chunk index (payload offsets like
     wordcount's byte positions stay stream-global across feeds)."""
 
-    __slots__ = ("acc", "pos", "waves", "feeds", "overflow", "broken")
+    __slots__ = ("acc", "pos", "waves", "feeds", "overflow", "broken",
+                 "last_feed_monotonic", "last_snapshot_monotonic")
 
     def __init__(self, acc) -> None:
         self.acc = acc
@@ -107,6 +160,10 @@ class _Stream:
         self.feeds = 0
         self.overflow = 0
         self.broken = False
+        #: monotonic instant the newest folded record arrived (set when
+        #: its feed completes) — the snapshot-staleness reference point
+        self.last_feed_monotonic: Optional[float] = None
+        self.last_snapshot_monotonic: Optional[float] = None
 
 
 class EngineSession:
@@ -133,6 +190,7 @@ class EngineSession:
         self._row_dtype = None
         self._streams: Dict[str, _Stream] = {}
         self._lock = threading.Lock()
+        _SESSIONS.add(self)
 
     # -- shape latching ----------------------------------------------------
 
@@ -247,14 +305,19 @@ class EngineSession:
             st.waves += W
             st.feeds += 1
             st.overflow += feed_oflow
+            # the staleness reference: the newest record this stream
+            # reflects arrived NOW (all of this feed's waves folded)
+            st.last_feed_monotonic = time.monotonic()
             _WAVES.inc(W, task=task)
             _SESSION_WAVES.inc(W, task=task)
             _FEEDS.inc(task=task)
             _CHUNKS.inc(S, task=task)
             if feed_oflow:
                 _OVERFLOWS.inc(feed_oflow, task=task)
-            _SESSION_SECONDS.inc(time.monotonic() - t0, stage="feed",
-                                 task=task)
+            feed_s = time.monotonic() - t0
+            _SESSION_SECONDS.inc(feed_s, stage="feed", task=task)
+            _slo.observe_session_op("feed", task, feed_s)
+        refresh_stream_age_gauges()
         if feed_oflow and on_overflow == "raise":
             raise SessionOverflowError(
                 f"session stream {task!r} overflowed {feed_oflow} rows "
@@ -292,8 +355,17 @@ class EngineSession:
             overflow = st.overflow
             _SNAPSHOTS.inc(task=task)
             _LIVE_RECORDS.set(int(np.asarray(n_live).sum()), task=task)
-            _SESSION_SECONDS.inc(time.monotonic() - t0, stage="snapshot",
-                                 task=task)
+            done = time.monotonic()
+            if st.last_feed_monotonic is not None:
+                # staleness: age of the newest record this snapshot
+                # reflects — feeds are serialized with snapshots, so
+                # the last completed feed IS the newest visible record
+                _slo.observe_staleness(task,
+                                       done - st.last_feed_monotonic)
+            st.last_snapshot_monotonic = done
+            _SESSION_SECONDS.inc(done - t0, stage="snapshot", task=task)
+            _slo.observe_session_op("snapshot", task, done - t0)
+        refresh_stream_age_gauges()
         return DeviceResult(keys_h, vals_h, pay_h, valid_h, overflow)
 
     def stats(self, task: Optional[str] = None) -> Dict[str, int]:
@@ -314,3 +386,5 @@ class EngineSession:
                 self._streams.pop(str(task), None)
             else:
                 self._streams.clear()
+        # a closed stream's age series must not linger as a lie
+        refresh_stream_age_gauges()

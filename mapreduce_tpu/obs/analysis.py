@@ -60,6 +60,10 @@ SKEW_RATIO = 2.0
 #: rows reported per section, largest offender first
 TOP_K = 5
 
+#: a tenant whose oldest QUEUED task is older than this gets an
+#: admission-backpressure note (obs/slo's queue-age gauge feeds it)
+QUEUE_AGE_NOTE_S = 60.0
+
 #: fault-path families (and the label subsets that make them faults)
 #: surfaced as hotspots when nonzero
 _HOTSPOT_FAMILIES: Tuple[Tuple[str, Optional[Dict[str, Any]]], ...] = (
@@ -655,6 +659,71 @@ def _sched_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# -- serving-SLO findings (obs/slo) ------------------------------------------
+
+
+def _slo_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """SLO health from the cluster-aggregated serving-SLO gauges
+    (obs/slo publishes them at every evaluation tick; the collector
+    merges them by MAX — worst process wins): per-(tenant, objective)
+    percentile estimates vs the threshold that was in force, short/long
+    burn rates, breach-tick counts, plus per-tenant oldest-queued-age
+    and per-stream staleness-age (the silent-staleness gauges)."""
+    pct: Dict[tuple, Dict[str, Any]] = {}
+    thresholds: Dict[str, float] = {}
+    burn: Dict[tuple, Dict[str, float]] = {}
+    breaches: Dict[tuple, int] = {}
+    queue_age: Dict[str, float] = {}
+    stream_age: Dict[str, Dict[str, float]] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name == "mrtpu_slo_percentile_seconds":
+            key = (labels.get("tenant", "-"),
+                   labels.get("objective", "-"))
+            pct[key] = {"p": value, "pct": labels.get("pct", "p99")}
+        elif name == "mrtpu_slo_threshold_seconds":
+            thresholds[labels.get("objective", "-")] = value
+        elif name == "mrtpu_slo_burn_rate":
+            key = (labels.get("tenant", "-"),
+                   labels.get("objective", "-"))
+            burn.setdefault(key, {})[
+                labels.get("window", "?")] = value
+        elif name == "mrtpu_slo_breach_total" and value:
+            key = (labels.get("tenant", "-"),
+                   labels.get("objective", "-"))
+            breaches[key] = breaches.get(key, 0) + int(value)
+        elif name == "mrtpu_sched_oldest_queued_age_seconds" and value:
+            queue_age[labels.get("tenant", "-")] = value
+        elif name == "mrtpu_session_stream_age_seconds":
+            stream_age.setdefault(labels.get("task", "-"), {})[
+                labels.get("stamp", "?")] = value
+    entries: List[Dict[str, Any]] = []
+    for (tenant, objective), row in sorted(pct.items()):
+        thr = thresholds.get(objective)
+        b = burn.get((tenant, objective), {})
+        entries.append({
+            "tenant": tenant,
+            "objective": objective,
+            "pct": row["pct"],
+            "p_s": round(row["p"], 6),
+            "threshold_s": thr,
+            "burn_short": b.get("short"),
+            "burn_long": b.get("long"),
+            "breach_ticks": breaches.get((tenant, objective), 0),
+            "breaching": bool(thr is not None and row["p"] > thr),
+        })
+    out: Dict[str, Any] = {}
+    if entries:
+        out["objectives"] = entries
+    if queue_age:
+        out["oldest_queued_age_s"] = {
+            t: round(v, 3) for t, v in sorted(queue_age.items())}
+    if stream_age:
+        out["stream_age_s"] = {
+            t: {k: round(v, 3) for k, v in sorted(s.items())}
+            for t, s in sorted(stream_age.items())}
+    return out
+
+
 # -- the report --------------------------------------------------------------
 
 
@@ -680,6 +749,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "memory": _memory_findings(doc),
         "comms": comms,
         "sched": _sched_findings(doc),
+        "slo": _slo_findings(doc),
         "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
         "trace_events": len(doc.get("traceEvents") or []),
@@ -751,6 +821,27 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
             "device {} memory pressure: {:.3g} of {:.3g} bytes in use "
             "({:.0%})".format(p["device"], float(p["bytes_in_use"]),
                               float(p["bytes_limit"]), p["ratio"]))
+    for e in report["slo"].get("objectives") or []:
+        if not e["breaching"]:
+            continue
+        burn_s = ""
+        if e.get("burn_long") is not None:
+            burn_s = ", burn {:.0f}x".format(e["burn_long"])
+            if (e.get("burn_short") is not None
+                    and round(e["burn_short"]) != round(e["burn_long"])):
+                burn_s += " (short-window {:.0f}x)".format(
+                    e["burn_short"])
+        notes.append(
+            "tenant {} {} {} {:.3g}s against {:g}s objective{}".format(
+                e["tenant"], e["pct"], e["objective"], e["p_s"],
+                e["threshold_s"], burn_s))
+    for tenant, age in sorted(
+            (report["slo"].get("oldest_queued_age_s") or {}).items()):
+        if age >= QUEUE_AGE_NOTE_S:
+            notes.append(
+                "tenant {} has a task queued for {:.0f}s — admission "
+                "backpressure (raise max_inflight or the tenant's "
+                "share)".format(tenant, age))
     for tenant, reasons in sorted(
             (report["sched"].get("rejections") or {}).items()):
         total = sum(reasons.values())
@@ -865,6 +956,28 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
                 "device execution{}".format(
                     cp["upload_overlap_frac"], cp.get("upload_s", 0.0),
                     " (FEEDER-BOUND)" if cp.get("feeder_bound") else ""))
+
+    slo = report.get("slo") or {}
+    if slo.get("objectives"):
+        lines.append("serving SLOs:")
+        for e in slo["objectives"]:
+            thr = ("" if e.get("threshold_s") is None
+                   else " / {:g}s objective".format(e["threshold_s"]))
+            burns = ""
+            if e.get("burn_long") is not None:
+                burns = "  burn {:.1f}x long".format(e["burn_long"])
+                if e.get("burn_short") is not None:
+                    burns += " / {:.1f}x short".format(e["burn_short"])
+            lines.append(
+                "  tenant {} {} {}: {:.3g}s{}{}{}".format(
+                    e["tenant"], e["pct"], e["objective"], e["p_s"],
+                    thr, burns,
+                    "  BREACHING" if e["breaching"] else ""))
+        for t, age in sorted(
+                (slo.get("oldest_queued_age_s") or {}).items()):
+            lines.append(
+                "  tenant {}: oldest queued task {:.1f}s old".format(
+                    t, age))
 
     sched = report.get("sched") or {}
     if sched.get("queue_depth") or sched.get("served_records"):

@@ -54,6 +54,7 @@ import json
 import logging
 import os
 import socket
+import sys
 import threading
 import time
 import uuid
@@ -161,6 +162,26 @@ DIAG_FAMILIES = frozenset({
     "mrtpu_sched_served_records_total",
     "mrtpu_session_chunks_total", "mrtpu_session_waves_total",
     "mrtpu_session_overflow_rows_total",
+    # the serving-SLO plane (obs/slo): per-tenant lifecycle histograms
+    # (cumulative _bucket/_sum/_count samples sum across processes —
+    # per-process monotonic totals, so the sum IS the cluster view),
+    # breach counts, and the derived percentile/burn/threshold gauges
+    # plus queue-age and stream-age gauges (all merged by MAX below:
+    # staleness and backpressure are worst-process quantities)
+    "mrtpu_slo_queue_wait_seconds_bucket",
+    "mrtpu_slo_queue_wait_seconds_sum",
+    "mrtpu_slo_queue_wait_seconds_count",
+    "mrtpu_slo_submit_first_result_seconds_bucket",
+    "mrtpu_slo_submit_first_result_seconds_sum",
+    "mrtpu_slo_submit_first_result_seconds_count",
+    "mrtpu_slo_snapshot_staleness_seconds_bucket",
+    "mrtpu_slo_snapshot_staleness_seconds_sum",
+    "mrtpu_slo_snapshot_staleness_seconds_count",
+    "mrtpu_slo_breach_total",
+    "mrtpu_slo_percentile_seconds", "mrtpu_slo_burn_rate",
+    "mrtpu_slo_threshold_seconds",
+    "mrtpu_sched_oldest_queued_age_seconds",
+    "mrtpu_session_stream_age_seconds",
 })
 
 #: diagnosis gauges that must merge across processes by MAX, not sum:
@@ -181,6 +202,15 @@ _DIAG_GAUGE_MAX = frozenset({
     "mrtpu_exchange_imbalance",
     "mrtpu_comms_modeled_exchange_seconds",
     "mrtpu_comms_exchange_frac_of_compute",
+    # the SLO plane's derived gauges: a percentile / burn rate / queue
+    # age / stream staleness-age summed across processes would be a
+    # fiction — the WORST process's view is what alerting wants, and
+    # staleness by contract merges by MAX (a fresh replica must not
+    # hide a stale one)
+    "mrtpu_slo_percentile_seconds", "mrtpu_slo_burn_rate",
+    "mrtpu_slo_threshold_seconds",
+    "mrtpu_sched_oldest_queued_age_seconds",
+    "mrtpu_session_stream_age_seconds",
 })
 
 #: and gauges where the WORST view is the smallest value: an overlap
@@ -578,6 +608,18 @@ class TelemetryPusher:
         """Send everything pending in one batch; True on delivery.
         Never raises — a failure parks the batch in the (bounded)
         backlog for the next flush."""
+        # age-style gauges must be recomputed at PUSH time, not frozen
+        # at their last session call: a stalled stream in a session
+        # host makes no more calls, and without this hook every push
+        # would re-send the last computed (small) age forever — hiding
+        # exactly the stall the stream-age gauge exists to expose.
+        # Guarded: only when the (jax-bound) session module is loaded.
+        sess_mod = sys.modules.get("mapreduce_tpu.engine.session")
+        if sess_mod is not None:
+            try:
+                sess_mod.refresh_stream_age_gauges()
+            except Exception:
+                logger.debug("stream-age refresh failed", exc_info=True)
         with self._flush_lock:
             seq, fresh, missed = self._tracer.events_since(self._last_seq)
             first_seq = seq - len(fresh) + 1  # ring seqs are contiguous

@@ -425,6 +425,21 @@ def write_bundle(out_dir: str, store: Any = None,
                   encoding="utf-8") as f:
             json.dump(comms_doc, f, indent=1, default=float)
         files.append("comms.json")
+    # the serving-SLO plane (obs/slo): per-tenant objective evaluation
+    # at capture time — strict-validated on write AND reload.  Only
+    # written when some tenant actually produced SLO observations: an
+    # empty file would read as "every objective green", which is a lie.
+    from .slo import slo_snapshot, validate_slo
+
+    slo_snap = slo_snapshot()
+    if slo_snap:
+        slo_doc = {"kind": "mrtpu-slo", "version": 1,
+                   "snapshot": slo_snap}
+        validate_slo(slo_doc)
+        with open(os.path.join(out_dir, "slo.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(slo_doc, f, indent=1, default=float)
+        files.append("slo.json")
     if cluster_doc is not None:
         from .analysis import diagnose
 
@@ -494,6 +509,14 @@ def load_bundle(path: str) -> Dict[str, Any]:
             comms_doc = json.load(f)
         validate_comms(comms_doc)
         out["comms"] = comms_doc
+    slo_path = os.path.join(path, "slo.json")
+    if os.path.exists(slo_path):
+        from .slo import validate_slo
+
+        with open(slo_path, encoding="utf-8") as f:
+            slo_doc = json.load(f)
+        validate_slo(slo_doc)
+        out["slo"] = slo_doc
     cluster_path = os.path.join(path, "cluster_trace.json")
     if os.path.exists(cluster_path):
         with open(cluster_path, encoding="utf-8") as f:

@@ -170,6 +170,17 @@ def comms_snapshot_section() -> Dict[str, Any]:
     return comms_snapshot()
 
 
+def slo_snapshot_section(collector=None) -> Dict[str, Any]:
+    """The SLO section of /statusz (obs/slo): per-tenant objective
+    percentiles, error budget and burn rates, evaluated at scrape time
+    over this process's histograms plus every collector-pushed
+    process's.  Empty when no tenant ever produced an SLO observation
+    — the section then stays off the page."""
+    from .slo import slo_snapshot
+
+    return slo_snapshot(collector=collector)
+
+
 def cluster_status(store, now: Optional[float] = None,
                    collector=None, scheduler=None) -> Dict[str, Any]:
     """The /statusz document: one entry per task database on the board,
@@ -200,6 +211,9 @@ def cluster_status(store, now: Optional[float] = None,
     comms = comms_snapshot_section()
     if comms:
         out["comms"] = comms
+    slo_sec = slo_snapshot_section(collector=collector)
+    if slo_sec:
+        out["slo"] = slo_sec
     if scheduler is not None:
         sched = scheduler.snapshot()
         if sched:

@@ -50,6 +50,7 @@ from ..coord import docstore
 from ..coord.lease import TrainerLease
 from ..coord.task import LeaseLostError
 from ..obs import metrics as _metrics
+from ..obs import slo as _slo
 from ..utils.constants import STATUS
 
 #: reserved database prefix for scheduler state on the board
@@ -107,6 +108,13 @@ _FENCES = _metrics.counter(
     "mrtpu_sched_fences_total",
     "ticks a scheduler refused to admit because its lease was "
     "definitively lost (a successor owns admission now)")
+_OLDEST_AGE = _metrics.gauge(
+    "mrtpu_sched_oldest_queued_age_seconds",
+    "age of each tenant's oldest QUEUED task, from the task docs' "
+    "persisted submit stamps (labels: tenant) — queue DEPTH says how "
+    "many wait, this says how LONG: backpressure is visible before it "
+    "bites; whole-family swap on every scheduler mutation and at "
+    "snapshot scrape")
 
 
 class QuotaExceededError(RuntimeError):
@@ -348,6 +356,11 @@ class Scheduler:
                 "submit_time": docstore.now(),
             }
             self.store.insert(TASKS_COLL, doc)
+            # the SLO plane's monotonic submit stamp: this process can
+            # now report EXACT queue-wait/first-result durations for
+            # transitions it also observes (obs/slo; cross-process
+            # observers fall back to the persisted submit_time)
+            _slo.stamp_submit(task_id, tenant)
             _ADMISSION.inc(tenant=tenant, outcome="accepted", reason="-")
             _TASK_EVENTS.inc(tenant=tenant, event="submitted")
             self._refresh_gauges()
@@ -425,6 +438,15 @@ class Scheduler:
                               "generation": gen}})
                 if doc is None:
                     continue  # cancelled in the race; re-read the queue
+                # queue wait (submit->admitted): exact monotonic when
+                # this process saw the submit, else the board's
+                # persisted stamps (cross-process degradation, the
+                # /statusz timestamp-comparison license)
+                wait = _slo.note_admitted(doc["_id"], tenant=tenant)
+                if wait is None:
+                    wait = (float(doc.get("admitted_time") or 0.0)
+                            - float(doc.get("submit_time") or 0.0))
+                _slo.observe_queue_wait(tenant, wait)
                 cost = max(float(cand.get("est_jobs") or 0), 1.0)
                 self.store.update(
                     TENANTS_COLL,
@@ -446,6 +468,11 @@ class Scheduler:
             TASKS_COLL, {"_id": task_id, "state": ADMITTED},
             {"$set": {"state": RUNNING, "started_time": docstore.now()}})
         if doc is not None:
+            dt = _slo.admitted_age(task_id)
+            if dt is None:
+                dt = (float(doc.get("started_time") or 0.0)
+                      - float(doc.get("admitted_time") or 0.0))
+            _slo.observe_admit_to_running(doc["tenant"], dt)
             _TASK_EVENTS.inc(tenant=doc["tenant"], event="running")
             self._refresh_gauges()
         return doc
@@ -460,6 +487,7 @@ class Scheduler:
         if doc is not None:
             _TASK_EVENTS.inc(tenant=doc["tenant"], event="done")
             self._release_db(doc)
+            _slo.drop_stamp(task_id)
             if records:
                 self.note_served(doc["tenant"], records)
             self._gc_terminal()
@@ -476,6 +504,7 @@ class Scheduler:
         if doc is not None:
             _TASK_EVENTS.inc(tenant=doc["tenant"], event="failed")
             self._release_db(doc)
+            _slo.drop_stamp(task_id)
             self._gc_terminal()
             self._refresh_gauges()
         return doc
@@ -567,6 +596,7 @@ class Scheduler:
             # the db first would let a cancel-then-resubmit successor
             # reserve it and then eat these late FINISHED/remove writes
             self._release_db(doc)
+        _slo.drop_stamp(task_id)
         self._gc_terminal()
         self._refresh_gauges()
         return doc
@@ -630,18 +660,28 @@ class Scheduler:
         if lease_doc is not None:
             out["lease"] = {"holder": lease_doc.get("holder"),
                             "generation": lease_doc.get("generation", 0)}
-        self._refresh_gauges(tasks=tasks)
+        oldest = self._refresh_gauges(tasks=tasks)
+        for t, age in oldest.items():
+            if t in tenants:
+                tenants[t]["oldest_queued_age_s"] = round(age, 3)
         return out
 
     def _refresh_gauges(self, tasks: Optional[List[Dict[str, Any]]] = None,
-                        ) -> None:
-        """Swap the whole queue-depth family atomically (the
-        update_board_gauges pattern): stale series from drained queues
-        must not linger as lies."""
+                        ) -> Dict[str, float]:
+        """Swap the whole queue-depth / queued-work / oldest-queued-age
+        families atomically (the update_board_gauges pattern): stale
+        series from drained queues must not linger as lies.  Returns
+        the per-tenant oldest-queued ages (the snapshot rides them)."""
         if tasks is None:
             tasks = self.store.find(TASKS_COLL)
         depth: Dict[tuple, int] = {}
         work: Dict[tuple, int] = {}
+        # queue AGE alongside queue depth: oldest QUEUED submit stamp
+        # per tenant, compared against the board's wall clock (persisted
+        # timestamps minted through docstore.now — the same timestamp-
+        # comparison license the /statusz lease view holds)
+        now_wall = docstore.now()
+        oldest: Dict[str, float] = {}
         for d in tasks:
             tenant = str(d.get("tenant", "-"))
             state = str(d.get("state", QUEUED))
@@ -651,12 +691,19 @@ class Scheduler:
                                           + int(d.get("est_jobs") or 0))
                 work[(tenant, "bytes")] = (work.get((tenant, "bytes"), 0)
                                            + int(d.get("est_bytes") or 0))
+                age = max(0.0, now_wall
+                          - float(d.get("submit_time") or now_wall))
+                oldest[tenant] = max(oldest.get(tenant, 0.0), age)
         _QUEUE_DEPTH.replace(
             [({"tenant": t, "state": s}, n)
              for (t, s), n in sorted(depth.items())])
         _QUEUED_WORK.replace(
             [({"tenant": t, "unit": u}, n)
              for (t, u), n in sorted(work.items())])
+        _OLDEST_AGE.replace(
+            [({"tenant": t}, round(a, 3))
+             for t, a in sorted(oldest.items())])
+        return oldest
 
     def release(self) -> None:
         """Clean handoff of the admission lease (a successor's

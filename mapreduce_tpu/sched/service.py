@@ -28,7 +28,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..coord import docstore
+from ..obs import slo as _slo
 from ..obs.metrics import REGISTRY
+from ..utils.constants import STATUS
 from ..worker import Worker
 from .scheduler import ADMITTED, INFLIGHT_STATES, RUNNING, Scheduler
 from .scheduler import TASKS_COLL
@@ -121,12 +123,62 @@ class TaskRunner:
             n = REGISTRY.sum("mrtpu_task_records_total", task=db)
         return int(n)
 
+    def _watch_first_result(self, doc: Dict[str, Any]) -> None:
+        """The SLO plane's running→first-job-written stamp: poll the
+        task db for its first WRITTEN job (one cheap count per poll
+        tick, bounded by the task's lifetime) and observe the tenant's
+        submit→first-result latency — exact monotonic when this process
+        saw the submit, else the board's persisted submit stamp."""
+        tid, db, tenant = doc["_id"], doc["db"], doc["tenant"]
+        store = self.scheduler.store
+        written_q = {"status": int(STATUS.WRITTEN)}
+        # a REUSED db (prior run DONE, resubmitted) still carries the
+        # previous run's WRITTEN job docs until the new Server's loop
+        # drops the collections: those must not read as an instant
+        # first result.  The first poll's count is the stale baseline;
+        # only a count that MOVED (the drop zeroes it, a fresh write
+        # raises it) is this run's first result.
+        baseline = None
+        while not self._stop.is_set():
+            try:
+                done = 0
+                for coll in (f"{db}.map_jobs", f"{db}.red_jobs"):
+                    done += store.count(coll, written_q)
+                if baseline is None:
+                    baseline = done
+                elif done == 0:
+                    baseline = 0  # the new run dropped the stale docs
+                if done and done != baseline:
+                    _slo.observe_first_result(
+                        tid, tenant,
+                        fallback_s=(docstore.now()
+                                    - float(doc.get("submit_time")
+                                            or docstore.now())))
+                    return
+                task = self.scheduler.get(tid)
+                if task is None or task.get("state") != RUNNING:
+                    return  # terminal before any job was written
+            except PermissionError:
+                # auth misconfig never heals on its own (the _loop
+                # carve-out): exit rather than spin at poll cadence
+                # forever — the SLO observation is telemetry, the
+                # runner's own loop surfaces the failure
+                logger.debug("first-result watcher for %s: auth "
+                             "rejected; giving up", tid)
+                return
+            except OSError:
+                pass  # board blip: telemetry degrades, never raises
+            self._stop.wait(max(self.poll, 0.02))
+
     def _run_task(self, doc: Dict[str, Any]) -> None:
         from ..server import Server  # late: keep the module jax-free
 
         tid = doc["_id"]
         if self.scheduler.mark_running(tid) is None:
             return  # a cancel won the race: never start the driver
+        threading.Thread(target=self._watch_first_result, args=(doc,),
+                         daemon=True,
+                         name=f"mr-slo-watch-{tid}").start()
         try:
             kw: Dict[str, Any] = {}
             if self.job_lease is not None:

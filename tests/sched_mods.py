@@ -29,6 +29,9 @@ class TenantState:
         self.STARTED: "collections.Counter" = collections.Counter()
         self.COMPLETED: "collections.Counter" = collections.Counter()
         self.lock = threading.Lock()
+        #: per-map-call sleep: a deliberately throttled tenant for the
+        #: serving-SLO isolation tests (0.0 = full speed)
+        self.map_delay = 0.0
 
 
 STATES: Dict[str, TenantState] = {}
@@ -55,6 +58,10 @@ def roles(name: str) -> Dict[str, Any]:
         st = state(name)
         with st.lock:
             st.STARTED[key] += 1
+        if st.map_delay > 0:
+            import time
+
+            time.sleep(st.map_delay)
         with open(value, "r") as f:
             for line in f:
                 for word in line.split():

@@ -229,7 +229,13 @@ def test_streaming_run_bounds_live_waves(wc_mesh, monkeypatch):
 
     monkeypatch.setattr(de, "_WaveFeeder", Spy)
     data = _random_text(n_words=20000, seed=7)
-    wc = DeviceWordCount(wc_mesh, chunk_len=1024)
+    # capacities right-sized for the 205-word vocab: this test bounds
+    # the INPUT-wave lifecycle (uint8 side), which capacities cannot
+    # touch — the default 64k-row sort per wave would only burn CI time
+    wc = DeviceWordCount(
+        wc_mesh, chunk_len=1024,
+        config=EngineConfig(local_capacity=4096, exchange_capacity=2048,
+                            out_capacity=4096))
     tm = {}
     got = wc.count_bytes(data, timings=tm, waves=5)
     assert got == _oracle(data)
@@ -358,7 +364,13 @@ def test_streaming_hbm_byte_bound(wc_mesh, monkeypatch):
 
     monkeypatch.setattr(de._WaveFeeder, "release", sampling_release)
     data = _random_text(n_words=60000, seed=9)
-    wc = DeviceWordCount(wc_mesh, chunk_len=512)
+    # capacities right-sized for the 205-word vocab (see the note in
+    # test_streaming_run_bounds_live_waves): every assertion here is
+    # about uint8 INPUT bytes, which the record capacities cannot touch
+    wc = DeviceWordCount(
+        wc_mesh, chunk_len=512,
+        config=EngineConfig(local_capacity=4096, exchange_capacity=2048,
+                            out_capacity=4096))
     tm = {}
     got = wc.count_bytes(data, timings=tm, waves=8)
     assert got == _oracle(data)

@@ -200,6 +200,11 @@ _MONOTONIC_ONLY_MODULES = {
     os.path.join("mapreduce_tpu", "sched", "service.py"),
     os.path.join("mapreduce_tpu", "engine", "session.py"),
     os.path.join("mapreduce_tpu", "engine", "topk.py"),
+    # the serving-SLO plane: burn-rate windows sample on monotonic
+    # time and every latency/staleness observation is duration data —
+    # a steppable clock would fabricate breaches (its only wall-clock
+    # inputs are persisted board timestamps handed in by callers)
+    os.path.join("mapreduce_tpu", "obs", "slo.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions

@@ -1,0 +1,519 @@
+"""Serving-SLO plane (obs/slo): bucket→percentile estimation, burn-rate
+window math, lifecycle instrumentation (queue wait / first result /
+snapshot staleness / stream-age gauges), the /statusz SLO section, the
+slo.json bundle artifact, and the acceptance scenario — one deliberately
+throttled tenant trips exactly its own objective under the sched chaos
+harness, and ``diagnose`` names that tenant and objective."""
+
+import math
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.obs import slo
+from mapreduce_tpu.obs.metrics import (
+    REGISTRY, Registry, SLO_BUCKETS, estimate_percentile, fraction_le)
+
+
+# -- bucket -> percentile estimation (obs/metrics) ---------------------------
+
+
+def test_estimate_percentile_interpolates_within_bucket():
+    bounds = [1.0, 2.0, 4.0, math.inf]
+    # 10 obs in (0,1], 10 in (1,2]
+    counts = [10, 10, 0, 0]
+    # median rank = 10 -> exactly fills the first bucket
+    assert estimate_percentile(bounds, counts, 0.5) == pytest.approx(1.0)
+    # 75th rank = 15 -> halfway through (1, 2]
+    assert estimate_percentile(bounds, counts, 0.75) == pytest.approx(1.5)
+    # p100 = top of the populated range
+    assert estimate_percentile(bounds, counts, 1.0) == pytest.approx(2.0)
+
+
+def test_estimate_percentile_empty_histogram_is_none():
+    bounds = list(SLO_BUCKETS)
+    assert estimate_percentile(bounds, [0] * len(bounds), 0.99) is None
+    assert estimate_percentile([], [], 0.99) is None
+    assert fraction_le(bounds, [0] * len(bounds), 1.0) is None
+
+
+def test_estimate_percentile_inf_bucket_clamps_to_largest_finite():
+    bounds = [0.5, 1.0, math.inf]
+    counts = [5, 0, 5]  # half the mass beyond every finite bound
+    # p99 rank lands in the +Inf bucket: the classic clamp
+    assert estimate_percentile(bounds, counts, 0.99) == pytest.approx(1.0)
+    # and +Inf mass never counts as <= any finite threshold
+    assert fraction_le(bounds, counts, 100.0) == pytest.approx(0.5)
+
+
+def test_fraction_le_interpolates_and_clips():
+    bounds = [1.0, 2.0, math.inf]
+    counts = [10, 10, 0]
+    assert fraction_le(bounds, counts, 1.0) == pytest.approx(0.5)
+    assert fraction_le(bounds, counts, 1.5) == pytest.approx(0.75)
+    assert fraction_le(bounds, counts, 0.5) == pytest.approx(0.25)
+    assert fraction_le(bounds, counts, 10.0) == pytest.approx(1.0)
+
+
+# -- burn-rate window math ---------------------------------------------------
+
+
+def _observe(reg, family, tenant, value, n=1):
+    h = reg.histogram(family, buckets=SLO_BUCKETS)
+    for _ in range(n):
+        h.observe(value, tenant=tenant)
+
+
+def test_burn_rate_multi_window_math():
+    """Injected clock, synthetic observations: a burst of over-threshold
+    samples burns the SHORT window hard while the long window dilutes
+    it — the multi-window shape the SRE alerting pattern rides on."""
+    reg = Registry()
+    obj = slo.SLOObjective("snapshot_staleness", slo.STALENESS_FAMILY,
+                           percentile=0.90, threshold_s=1.0,
+                           long_window_s=600.0, short_window_s=60.0)
+    plane = slo.SloPlane([obj])
+    tenant = f"burn-{uuid.uuid4().hex[:6]}"
+
+    # t=0: 100 healthy observations
+    _observe(reg, slo.STALENESS_FAMILY, tenant, 0.01, n=100)
+    snap = plane.evaluate(registry=reg, now=1000.0)
+    e = snap["tenants"][tenant]["snapshot_staleness"]
+    assert e["burn_short"] == 0.0 and e["burn_long"] == 0.0
+    assert not e["breaching"]
+
+    # 500s later (outside the short window, inside the long): an
+    # all-bad burst of 100 observations at 5s
+    _observe(reg, slo.STALENESS_FAMILY, tenant, 5.0, n=100)
+    snap = plane.evaluate(registry=reg, now=1500.0)
+    e = snap["tenants"][tenant]["snapshot_staleness"]
+    # short window: only the burst (100% bad) -> burn = 1.0/0.1 = 10x
+    assert e["burn_short"] == pytest.approx(10.0, rel=0.01)
+    # long window: 100 bad of 200 -> burn = 0.5/0.1 = 5x
+    assert e["burn_long"] == pytest.approx(5.0, rel=0.01)
+    assert e["window_n"] == 200
+    # long-window p90 rank lands in the bad mass -> breach
+    assert e["breaching"]
+
+    # 700s later the burst has aged OUT of the long window: only
+    # whatever arrived since remains.  Feed fresh healthy samples.
+    _observe(reg, slo.STALENESS_FAMILY, tenant, 0.01, n=100)
+    snap = plane.evaluate(registry=reg, now=2200.0)
+    e = snap["tenants"][tenant]["snapshot_staleness"]
+    assert e["burn_long"] == 0.0 and not e["breaching"]
+
+
+def test_breach_counter_names_tenant_and_objective():
+    reg = Registry()
+    obj = slo.SLOObjective("snapshot_staleness", slo.STALENESS_FAMILY,
+                           percentile=0.50, threshold_s=0.1)
+    plane = slo.SloPlane([obj])
+    good, bad = (f"iso-{uuid.uuid4().hex[:6]}" for _ in range(2))
+    _observe(reg, slo.STALENESS_FAMILY, good, 0.01, n=10)
+    _observe(reg, slo.STALENESS_FAMILY, bad, 2.0, n=10)
+    b0_bad = REGISTRY.value("mrtpu_slo_breach_total", tenant=bad,
+                            objective="snapshot_staleness")
+    plane.evaluate(registry=reg, now=10.0)
+    plane.evaluate(registry=reg, now=11.0)
+    assert REGISTRY.value("mrtpu_slo_breach_total", tenant=bad,
+                          objective="snapshot_staleness") == b0_bad + 2
+    assert REGISTRY.value("mrtpu_slo_breach_total", tenant=good,
+                          objective="snapshot_staleness") == 0
+
+
+def test_breach_detection_survives_inf_bucket_clamp():
+    """A threshold beyond the largest finite SLO bucket bound must not
+    blind the breach flag: the percentile estimate clamps to the last
+    finite bound, but the burn path counts +Inf mass as over ANY
+    finite threshold, and the breach criterion ORs the two."""
+    reg = Registry()
+    obj = slo.SLOObjective("queue_wait", slo.QUEUE_WAIT_FAMILY,
+                           percentile=0.5, threshold_s=10_000.0)
+    plane = slo.SloPlane([obj])
+    tenant = f"inf-{uuid.uuid4().hex[:6]}"
+    # every observation beyond the 600s top finite rung -> +Inf bucket
+    _observe(reg, slo.QUEUE_WAIT_FAMILY, tenant, 50_000.0, n=10)
+    snap = plane.evaluate(registry=reg, now=5.0)
+    e = snap["tenants"][tenant]["queue_wait"]
+    assert e["p"] == pytest.approx(600.0)  # the documented clamp
+    assert e["burn_long"] == pytest.approx(2.0)  # 100% bad / 50% budget
+    assert e["breaching"], e
+
+
+def test_parse_objective_specs():
+    o = slo.parse_objective("queue_wait:p99.9:2.5:300:30")
+    assert o.family == slo.QUEUE_WAIT_FAMILY
+    assert o.percentile == pytest.approx(0.999)
+    assert o.threshold_s == 2.5
+    assert o.long_window_s == 300.0 and o.short_window_s == 30.0
+    assert o.pct_label == "p99.9"
+    # defaults for the windows
+    o2 = slo.parse_objective("snapshot_staleness:p95:0.5")
+    assert (o2.long_window_s, o2.short_window_s) == (600.0, 60.0)
+    for bad in ("nope:p99:1", "queue_wait:p99", "queue_wait:p0:1",
+                "queue_wait:p99:0", "queue_wait:p99:1:10:60"):
+        with pytest.raises(ValueError):
+            slo.parse_objective(bad)
+
+
+# -- scheduler lifecycle instrumentation -------------------------------------
+
+
+def test_queue_wait_histogram_and_oldest_age_gauge():
+    from mapreduce_tpu.coord.docstore import MemoryDocStore
+    from mapreduce_tpu.sched.scheduler import Scheduler, SchedulerConfig
+
+    tenant = f"qw-{uuid.uuid4().hex[:6]}"
+    sch = Scheduler(MemoryDocStore(),
+                    config=SchedulerConfig(max_inflight=1))
+    sch.submit(tenant, est_jobs=1)
+    sch.submit(tenant, est_jobs=1)
+    q0 = REGISTRY.value(slo.QUEUE_WAIT_FAMILY, tenant=tenant)
+    sch.tick()  # admits exactly one (budget 1)
+    assert REGISTRY.value(slo.QUEUE_WAIT_FAMILY, tenant=tenant) == q0 + 1
+    # the un-admitted task surfaces as queue AGE, in the gauge AND the
+    # /tasks snapshot (queue depth existed; queue age is the new signal)
+    snap = sch.snapshot()
+    age = snap["tenants"][tenant].get("oldest_queued_age_s")
+    assert age is not None and age >= 0.0
+    assert REGISTRY.value("mrtpu_sched_oldest_queued_age_seconds",
+                          tenant=tenant) == pytest.approx(age, abs=0.5)
+    # draining the queue clears the series (whole-family swap)
+    sch.cancel(sch.list_tasks(tenant=tenant, state="QUEUED")[0]["_id"])
+    assert REGISTRY.value("mrtpu_sched_oldest_queued_age_seconds",
+                          tenant=tenant) == 0.0
+
+
+def test_admit_to_running_observed_on_mark_running():
+    from mapreduce_tpu.coord.docstore import MemoryDocStore
+    from mapreduce_tpu.sched.scheduler import Scheduler
+
+    tenant = f"ar-{uuid.uuid4().hex[:6]}"
+    sch = Scheduler(MemoryDocStore())
+    doc = sch.submit(tenant, est_jobs=1)
+    sch.tick()
+    a0 = REGISTRY.value("mrtpu_slo_admit_to_running_seconds",
+                        tenant=tenant)
+    assert sch.mark_running(doc["_id"]) is not None
+    assert REGISTRY.value("mrtpu_slo_admit_to_running_seconds",
+                          tenant=tenant) == a0 + 1
+
+
+# -- session staleness + stream-age gauges (the silent-staleness gap) --------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from mapreduce_tpu.parallel import make_mesh
+
+    return make_mesh()
+
+
+def _session(mesh, task="slo-sess"):
+    from mapreduce_tpu.engine.device_engine import EngineConfig
+    from mapreduce_tpu.engine.session import EngineSession
+    from mapreduce_tpu.engine.wordcount import wordcount_map_fn
+
+    cfg = EngineConfig(local_capacity=4096, exchange_capacity=2048,
+                       out_capacity=4096, tile=512, tile_records=128,
+                       combine_in_scan=True, unit_values=True,
+                       reduce_op="sum")
+    return EngineSession(mesh, wordcount_map_fn, cfg, task=task)
+
+
+def _chunks():
+    from mapreduce_tpu.ops.tokenize import shard_text
+
+    corpus = b"alpha beta gamma delta epsilon zeta " * 600
+    chunks, _ = shard_text(corpus, 8, pad_multiple=512, pad_to=4096 + 512)
+    return chunks
+
+
+def test_session_staleness_and_stream_age_gauges(mesh):
+    from mapreduce_tpu.engine.session import refresh_stream_age_gauges
+
+    fresh, stale = (f"ss-{uuid.uuid4().hex[:5]}" for _ in range(2))
+    sess = _session(mesh)
+    chunks = _chunks()
+    try:
+        sess.feed(chunks, task=stale)
+        time.sleep(0.15)
+        sess.feed(chunks, task=fresh)
+        # staleness is observed at snapshot time, per stream
+        s_stale = sess.snapshot(stale)
+        sess.snapshot(fresh)
+        assert s_stale.overflow == 0
+        assert REGISTRY.value(slo.STALENESS_FAMILY, tenant=stale) == 1
+        assert REGISTRY.value(slo.STALENESS_FAMILY, tenant=fresh) == 1
+        # the stale stream's observation is at least the sleep + the
+        # fresh stream's feed; the SLO section sees the difference
+        plane = slo.SloPlane([slo.SLOObjective(
+            "snapshot_staleness", slo.STALENESS_FAMILY,
+            percentile=0.5, threshold_s=0.1)])
+        snap = plane.evaluate()
+        assert snap["tenants"][stale]["snapshot_staleness"]["p"] > 0.1
+        # stream-age gauges exist WITHOUT any snapshot being polled —
+        # the silent-staleness guard
+        time.sleep(0.05)
+        refresh_stream_age_gauges()
+        age = REGISTRY.value("mrtpu_session_stream_age_seconds",
+                             task=stale, stamp="feed")
+        assert age >= 0.15
+        assert REGISTRY.value("mrtpu_session_stream_age_seconds",
+                              task=stale, stamp="snapshot") > 0.0
+        # per-op latency histograms landed
+        assert REGISTRY.value("mrtpu_slo_session_op_seconds",
+                              tenant=stale, op="feed") >= 1
+        assert REGISTRY.value("mrtpu_slo_session_op_seconds",
+                              tenant=stale, op="snapshot") >= 1
+    finally:
+        sess.close()
+    # closing swaps the whole family: no stale lies linger
+    assert REGISTRY.value("mrtpu_session_stream_age_seconds",
+                          task=stale, stamp="feed") == 0.0
+
+
+# -- /statusz section + render + bundle (the plumbing tests) -----------------
+
+
+def test_statusz_slo_section_and_cli_render():
+    from mapreduce_tpu.cli import _render_slo
+    from mapreduce_tpu.obs.statusz import slo_snapshot_section
+
+    tenant = f"rz-{uuid.uuid4().hex[:6]}"
+    slo.observe_staleness(tenant, 4.2)
+    sec = slo_snapshot_section()
+    assert sec["tenants"][tenant]["snapshot_staleness"]["breaching"]
+    names = {o["name"] for o in sec["objectives"]}
+    assert {"submit_first_result", "snapshot_staleness",
+            "queue_wait"} <= names
+    text = "\n".join(_render_slo(sec))
+    assert "serving SLOs" in text
+    assert tenant in text and "BREACHING" in text
+
+
+def test_statusz_over_http_carries_slo_section():
+    from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+
+    tenant = f"hz-{uuid.uuid4().hex[:6]}"
+    slo.observe_staleness(tenant, 0.002)
+    srv = DocServer().start_background()
+    client = HttpDocStore(f"{srv.host}:{srv.port}")
+    try:
+        snap = client.statusz()
+        assert tenant in snap["slo"]["tenants"]
+        # /metrics carries the evaluation gauges, scrape-fresh
+        from mapreduce_tpu.obs.metrics import parse_prometheus
+
+        parsed = parse_prometheus(client.metrics_text())
+        assert any(n == "mrtpu_slo_percentile_seconds"
+                   and dict(lk).get("tenant") == tenant
+                   for (n, lk) in parsed)
+    finally:
+        client.close()
+        srv.shutdown()
+
+
+def test_slo_bundle_round_trip_and_validator(tmp_path):
+    from mapreduce_tpu.obs.profile import load_bundle, write_bundle
+
+    tenant = f"bd-{uuid.uuid4().hex[:6]}"
+    slo.observe_staleness(tenant, 0.01)
+    out = str(tmp_path / "bundle")
+    write_bundle(out)
+    loaded = load_bundle(out)
+    assert loaded["slo"]["kind"] == "mrtpu-slo"
+    assert tenant in loaded["slo"]["snapshot"]["tenants"]
+    assert "slo.json" in loaded["manifest"]["files"]
+    # corrupt artifact -> loud refusal on reload
+    (tmp_path / "bundle" / "slo.json").write_text(
+        '{"kind": "mrtpu-slo", "snapshot": {"objectives": [], '
+        '"tenants": {}}}')
+    with pytest.raises(ValueError):
+        load_bundle(out)
+
+
+def test_validate_slo_shapes():
+    ok = {"kind": "mrtpu-slo", "snapshot": {
+        "objectives": [{"name": "snapshot_staleness", "percentile": 0.99,
+                        "threshold_s": 1.0, "long_window_s": 600.0,
+                        "short_window_s": 60.0}],
+        "tenants": {"a": {"snapshot_staleness": {
+            "n": 3, "burn_short": 0.0, "burn_long": 0.0,
+            "breaching": False}}}}}
+    slo.validate_slo(ok)
+    for breakage in (
+            lambda d: d.pop("kind"),
+            lambda d: d["snapshot"].pop("objectives"),
+            lambda d: d["snapshot"]["objectives"][0].pop("threshold_s"),
+            lambda d: d["snapshot"]["tenants"]["a"][
+                "snapshot_staleness"].pop("burn_long"),
+            lambda d: d["snapshot"]["tenants"]["a"][
+                "snapshot_staleness"].pop("breaching")):
+        import copy
+
+        doc = copy.deepcopy(ok)
+        breakage(doc)
+        with pytest.raises(ValueError):
+            slo.validate_slo(doc)
+
+
+# -- diagnose: the breach note names tenant + objective ----------------------
+
+
+def _doc_with_metrics(rows):
+    return {"traceEvents": [],
+            "mrtpuCluster": {"aligned_to": "t", "procs": {},
+                             "metrics": [list(r) for r in rows]}}
+
+
+def test_diagnose_names_breaching_tenant_and_objective():
+    from mapreduce_tpu.obs.analysis import diagnose, render_diagnosis
+
+    rows = [
+        ["mrtpu_slo_percentile_seconds",
+         {"tenant": "b", "objective": "snapshot_staleness",
+          "pct": "p99"}, 4.2],
+        ["mrtpu_slo_percentile_seconds",
+         {"tenant": "a", "objective": "snapshot_staleness",
+          "pct": "p99"}, 0.02],
+        ["mrtpu_slo_threshold_seconds",
+         {"objective": "snapshot_staleness", "pct": "p99"}, 1.0],
+        ["mrtpu_slo_burn_rate",
+         {"tenant": "b", "objective": "snapshot_staleness",
+          "window": "long"}, 12.0],
+        ["mrtpu_slo_burn_rate",
+         {"tenant": "b", "objective": "snapshot_staleness",
+          "window": "short"}, 12.4],
+        ["mrtpu_slo_breach_total",
+         {"tenant": "b", "objective": "snapshot_staleness"}, 3.0],
+        ["mrtpu_sched_oldest_queued_age_seconds", {"tenant": "b"}, 120.0],
+    ]
+    report = diagnose(_doc_with_metrics(rows))
+    entries = {(e["tenant"], e["objective"]): e
+               for e in report["slo"]["objectives"]}
+    assert entries[("b", "snapshot_staleness")]["breaching"]
+    assert not entries[("a", "snapshot_staleness")]["breaching"]
+    note = [n for n in report["notes"]
+            if "tenant b p99 snapshot_staleness" in n]
+    assert note and "against 1s objective" in note[0] \
+        and "burn 12x" in note[0], report["notes"]
+    assert not any("tenant a p99" in n for n in report["notes"])
+    assert any("queued for 120s" in n for n in report["notes"])
+    rendered = render_diagnosis(report)
+    assert "serving SLOs:" in rendered and "BREACHING" in rendered
+
+
+# -- the acceptance scenario: throttled tenant under the chaos harness -------
+
+
+@pytest.mark.chaos
+@pytest.mark.telemetry
+def test_throttled_tenant_trips_only_its_own_objective(tmp_path):
+    """One deliberately slow tenant (per-map-call sleep) served next to
+    a fast one by the real scheduler/runner/worker stack: the slow
+    tenant's submit→first-result breaches its objective, the fast
+    tenant's does not, and ``diagnose`` over the collector's merged
+    cluster doc names exactly the slow tenant and its objective."""
+    from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+    from mapreduce_tpu.obs.analysis import diagnose
+    from mapreduce_tpu.obs.collector import TelemetryPusher
+    from mapreduce_tpu.sched.scheduler import Scheduler, SchedulerConfig
+    from mapreduce_tpu.sched.service import (
+        ScheduledWorker, TaskRunner, wait_for_state)
+    from tests import sched_mods
+
+    def _params(name, n_files):
+        files = []
+        for i in range(n_files):
+            p = tmp_path / f"{name}{i}.txt"
+            p.write_text(f"alpha beta {name}{i} gamma\n" * 4)
+            files.append(str(p))
+        st = sched_mods.reset(name, files)
+        m = f"tests.sched_mod_{name}"
+        params = {r: m for r in ("taskfn", "mapfn", "partitionfn",
+                                 "reducefn", "finalfn")}
+        params["storage"] = f"mem:{uuid.uuid4().hex}"
+        return st, params
+
+    st_a, params_a = _params("a", 1)
+    st_b, params_b = _params("b", 1)
+    # the throttle: the slow tenant's only map job cannot be written
+    # before 1.2s; the threshold sits between the fast tenant's path
+    # (poll cadences + one quick map, well under a second: a single
+    # observation in the (0.5, 1.0] rung estimates p50 = 0.75 < 0.8)
+    # and the slow one's (1.0, 2.5] rung (estimate >= 1.75)
+    st_b.map_delay = 1.2
+
+    board = DocServer().start_background()
+    # configure the GLOBAL plane (the --slo deployment path): scrape
+    # endpoints evaluate it, so a private plane's gauges would be
+    # clobbered by the /clusterz evaluation tick the diagnose path runs
+    prev_objectives = list(slo.PLANE.objectives)
+    slo.configure([slo.SLOObjective(
+        "submit_first_result", slo.FIRST_RESULT_FAMILY,
+        percentile=0.5, threshold_s=0.8, long_window_s=600.0,
+        short_window_s=60.0)])
+    runner = None
+    workers = []
+    pusher = None
+    try:
+        direct = f"http://{board.host}:{board.port}"
+        sch = Scheduler(board.store,
+                        config=SchedulerConfig(max_inflight=2))
+        runner = TaskRunner(direct, sch).start()
+        workers = [ScheduledWorker(direct, name=f"slow{i}").start()
+                   for i in range(2)]
+        da = sch.submit("fast", db="slo_a", params=params_a, est_jobs=1)
+        db = sch.submit("slow", db="slo_b", params=params_b, est_jobs=1)
+        wait_for_state(sch, da["_id"], "DONE", timeout=90)
+        wait_for_state(sch, db["_id"], "DONE", timeout=90)
+        # both tenants ran exactly once per job (the witness)
+        assert dict(st_a.COMPLETED) == {0: 1}
+        assert dict(st_b.COMPLETED) == {0: 1}
+        # both produced a first-result observation
+        assert REGISTRY.value(slo.FIRST_RESULT_FAMILY,
+                              tenant="fast") == 1
+        assert REGISTRY.value(slo.FIRST_RESULT_FAMILY,
+                              tenant="slow") == 1
+        snap = slo.evaluate()
+        fast = snap["tenants"]["fast"]["submit_first_result"]
+        slow = snap["tenants"]["slow"]["submit_first_result"]
+        assert slow["breaching"] and slow["p"] > 0.8, (fast, slow)
+        assert not fast["breaching"], (fast, slow)
+        assert REGISTRY.value("mrtpu_slo_breach_total", tenant="slow",
+                              objective="submit_first_result") >= 1
+        assert REGISTRY.value("mrtpu_slo_breach_total", tenant="fast",
+                              objective="submit_first_result") == 0
+
+        # the acceptance gate: diagnose over the merged cluster doc
+        # names exactly the slow tenant and its breached objective
+        pusher = TelemetryPusher(f"{board.host}:{board.port}",
+                                 role="slo-test", interval=60.0)
+        assert pusher.flush()
+        client = HttpDocStore(f"{board.host}:{board.port}")
+        try:
+            report = diagnose(client.clusterz())
+        finally:
+            client.close()
+        breach_notes = [n for n in report["notes"]
+                        if "submit_first_result" in n
+                        and "objective" in n]
+        # of THIS test's tenancy, exactly the throttled tenant is
+        # named (the shared-process registry may carry other suites'
+        # tenants; "fast" must never appear)
+        assert any("tenant slow" in n for n in breach_notes), (
+            report["notes"])
+        assert not any("tenant fast" in n for n in breach_notes), (
+            breach_notes)
+    finally:
+        slo.configure(prev_objectives)
+        if pusher:
+            pusher.stop(flush=False)
+        if runner:
+            runner.stop()
+        for w in workers:
+            w.stop(timeout=20)
+        board.shutdown()
