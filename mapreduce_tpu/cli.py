@@ -320,6 +320,14 @@ def cmd_wordcount(argv: List[str]) -> int:
     p.add_argument("--device", action="store_true",
                    help="use the SPMD device engine instead of the "
                         "host job-board path")
+    p.add_argument("--sort-impl", choices=("variadic", "argsort",
+                                           "tiered"), default=None,
+                   help="device-engine sort formulation: 'tiered' "
+                        "serves a cold machine on the fast-compiling "
+                        "argsort tier-0 and hot-swaps to the variadic "
+                        "tier-1 when its background compile lands "
+                        "(first results in the small compile's time); "
+                        "default is the module's config (variadic)")
     p.add_argument("--workers", type=int, default=4)
     p.add_argument("--num-reducers", type=int, default=15)
     _add_compile_cache(p)
@@ -347,7 +355,12 @@ def cmd_wordcount(argv: List[str]) -> int:
         # the unified fast path: the same server machinery dispatches the
         # fused map+shuffle+reduce to the SPMD engine — no workers needed
         params["device"] = True
-    else:
+        if args.sort_impl:
+            params["init_args"]["device_sort_impl"] = args.sort_impl
+    elif args.sort_impl:
+        print("WARNING: --sort-impl only affects the device engine "
+              "(--device); the host path ignores it", file=sys.stderr)
+    if not args.device:
         from .worker import spawn_worker_threads
 
         threads = spawn_worker_threads(connstr, "wc", args.workers)
@@ -1495,6 +1508,13 @@ def cmd_warmup(argv: List[str]) -> int:
     p.add_argument("--bench", action="store_true",
                    help="use bench.py's engine capacities instead of the "
                         "DeviceWordCount defaults")
+    p.add_argument("--tier", choices=("0", "1", "both"), default="both",
+                   help="which compile tier(s) to prime: 0 = the "
+                        "fast-compile argsort serving program, 1 = the "
+                        "steady-state variadic program, both (default) "
+                        "= both — a fully warmed machine never serves "
+                        "tier-0, because the tiered engine's warmness "
+                        "probe finds tier-1 primed and skips tiering")
     p.add_argument("--replay", action="store_true",
                    help="additionally AOT-prime EVERY bucket the shape "
                         "registry (obs/compile, written next to the "
@@ -1523,9 +1543,17 @@ def cmd_warmup(argv: List[str]) -> int:
     from .obs.compile import LEDGER, registry_path
     from .parallel import make_mesh
 
+    from dataclasses import replace as _dc_replace
+
     mesh = make_mesh()
     cfg = bench_engine_config() if args.bench else None
     wc = DeviceWordCount(mesh, chunk_len=args.chunk_len, config=cfg)
+    # --tier: prime the argsort serving program ('0'), the variadic
+    # steady-state program ('1'), or both ('tiered' precompiles both
+    # per-tier programs through the same ledger path a tiered run uses)
+    wc.config = _dc_replace(
+        wc.config, sort_impl={"0": "argsort", "1": "variadic",
+                              "both": "tiered"}[args.tier])
     secs = wc.warm()
     # the seconds land in the metrics registry (mrtpu_compile_seconds /
     # mrtpu_compile_total via the ledger), not just stdout
@@ -1536,6 +1564,7 @@ def cmd_warmup(argv: List[str]) -> int:
           f"{wave.get('persistent_hit', 0)} persistent-cache hit / "
           f"{wave.get('cached', 0)} cached; shape registry at "
           f"{registry_path(path)}")
+    replay_tiers = {}
     if args.replay:
         from .engine.device_engine import replay_registry
 
@@ -1543,6 +1572,9 @@ def cmd_warmup(argv: List[str]) -> int:
         for row in replay_registry(mesh, path):
             if "seconds" in row:
                 primed += 1
+                if row.get("tier") is not None:
+                    replay_tiers[int(row["tier"])] = (
+                        replay_tiers.get(int(row["tier"]), 0) + 1)
                 print(f"  replayed {row['program']} bucket "
                       f"{row['bucket']}: {row['seconds']:.1f}s")
             else:
@@ -1550,6 +1582,30 @@ def cmd_warmup(argv: List[str]) -> int:
                 print(f"  skipped {row['program']} bucket "
                       f"{row['bucket']}: {row['skipped']}")
         print(f"replay: {primed} bucket(s) primed, {skipped} skipped")
+    # exit with a per-tier summary: every wave bucket the ledger built
+    # this run, grouped by compile tier (the registry's schema-v2 tier
+    # field) — the operator-facing record of what is now warm
+    tiers = {}
+    for rec in LEDGER.buckets():
+        if rec.get("program") != "wave":
+            continue
+        t = rec.get("tier")
+        row = tiers.setdefault(t, {"buckets": 0, "compile_s": 0.0})
+        row["buckets"] += 1
+        row["compile_s"] += (float(rec.get("compile_s", 0.0))
+                             + float(rec.get("lowering_s", 0.0)))
+    names = {0: "tier 0 (argsort, fast-compile serving)",
+             1: "tier 1 (variadic, steady state)",
+             None: "untiered"}
+    print("per-tier summary:")
+    for t in sorted(tiers, key=lambda x: (x is None, x)):
+        extra = (f" (+{replay_tiers[t]} replayed)"
+                 if t in replay_tiers else "")
+        print(f"  {names.get(t, t)}: {tiers[t]['buckets']} bucket(s), "
+              f"{tiers[t]['compile_s']:.1f}s compile{extra}")
+    if not tiers:
+        print("  (no wave buckets compiled this run — everything was "
+              "already cached)")
     return 0
 
 

@@ -146,6 +146,18 @@ class EngineConfig:
     #: golden suite pins that it never changes fold values, and the
     #: bench smoke that it adds no dispatches.
     exchange_stats: bool = True
+    #: sort formulation (ops/segscan.sorted_unique_reduce):
+    #:   'variadic' — ONE 2-key sort per stage (best runtime, worst
+    #:     comparator compile; the steady-state tier-1 program);
+    #:   'argsort' — two-pass stable 1-key argsort (compiles ~3x
+    #:     faster, runs slower; the tier-0 serving program);
+    #:   'tiered'  — dispatch-level policy (engine/tiering.py): a COLD
+    #:     shape bucket is served on tier-0 immediately while one
+    #:     background thread compiles tier-1, hot-swapped at a wave
+    #:     boundary (bit-identical by lax.sort stability, so the swap
+    #:     is invisible in results); warm buckets go straight to
+    #:     tier-1 and nothing changes.
+    sort_impl: str = "variadic"
 
     def cache_key(self):
         # the op object itself is part of the key: keeping it in the
@@ -155,7 +167,7 @@ class EngineConfig:
                 self.out_capacity, self.tile, self.tile_records,
                 self.reduce_op, self.unit_values, self.combine_in_scan,
                 self.combine_capacity, self.rank_sort,
-                self.exchange_stats)
+                self.exchange_stats, self.sort_impl)
 
     def scan_combine_slots(self, T: int) -> int:
         """Static buffer slots one chunk's pre-reduced records occupy
@@ -178,6 +190,26 @@ _WAVE_DONATE_ARGNUMS = (0, 1, 3, 4, 5, 6)
 def _wave_donate_argnums(cfg: "EngineConfig"):
     return (_WAVE_DONATE_ARGNUMS + (7,) if cfg.exchange_stats
             else _WAVE_DONATE_ARGNUMS)
+
+
+_SORT_IMPLS = ("variadic", "argsort", "tiered")
+
+
+def _tier_cfgs(cfg: EngineConfig):
+    """The two concrete per-tier program configs a ``'tiered'`` policy
+    resolves to: (tier-0 argsort, tier-1 variadic).  The accumulator
+    layout is identical across them — only the sort formulation inside
+    the program differs — so the donated carry threads straight through
+    a mid-run hot swap."""
+    return (replace(cfg, sort_impl="argsort"),
+            replace(cfg, sort_impl="variadic"))
+
+
+def _steady_cfg(cfg: EngineConfig) -> EngineConfig:
+    """The steady-state program config: ``'tiered'`` normalizes to the
+    tier-1 variadic config so shared satellites (accumulator-init
+    program, fin-row avals) key identically to a pure tier-1 engine."""
+    return (_tier_cfgs(cfg)[1] if cfg.sort_impl == "tiered" else cfg)
 
 
 def _capacities(cfg: EngineConfig) -> dict:
@@ -356,10 +388,18 @@ class DeviceEngine:
     def __init__(self, mesh: Mesh, map_fn: Callable,
                  config: EngineConfig = EngineConfig(),
                  task: str = "-") -> None:
+        if config.sort_impl not in _SORT_IMPLS:
+            raise ValueError(
+                f"EngineConfig.sort_impl must be one of {_SORT_IMPLS}, "
+                f"got {config.sort_impl!r}")
         self.mesh = mesh
         self.map_fn = map_fn
         self.config = config
         self.n_dev = mesh.shape[AXIS]
+        #: ONE background tier-1 compile thread per engine
+        #: (engine/tiering.py), created on the first cold tiered
+        #: dispatch
+        self._tier_spec = None
         #: low-cardinality accounting label on every metric this engine
         #: emits (the owning task's database name; "-" outside the task
         #: machinery) — the cluster collector rolls device seconds and
@@ -376,6 +416,10 @@ class DeviceEngine:
     # -- the SPMD program --------------------------------------------------
 
     def _program(self, cfg: EngineConfig):
+        # a 'tiered' policy never reaches tracing: the dispatch layer
+        # (engine/tiering.py) resolves it to one of the two concrete
+        # per-tier configs first
+        assert cfg.sort_impl in ("variadic", "argsort"), cfg.sort_impl
         map_fn = self.map_fn
         local_op, local_unit, fin_op = _stage_ops(cfg)
 
@@ -408,7 +452,8 @@ class DeviceEngine:
                     lambda kk, vv, pp, mm: sorted_unique_reduce(
                         kk, vv, pp, mm, Tc, cfg.reduce_op,
                         unit_values=cfg.unit_values,
-                        rank_sort=cfg.rank_sort),
+                        rank_sort=cfg.rank_sort,
+                        sort_impl=cfg.sort_impl),
                     keys0, vals0, pay0, valid0)
                 v_shape, v_dtype = cu0.values.shape[1:], cu0.values.dtype
             else:
@@ -442,7 +487,8 @@ class DeviceEngine:
                     cu = sorted_unique_reduce(
                         keys, vals, pay, valid, Tc, cfg.reduce_op,
                         unit_values=cfg.unit_values,
-                        rank_sort=cfg.rank_sort)
+                        rank_sort=cfg.rank_sort,
+                        sort_impl=cfg.sort_impl)
                     keys, vals, pay, valid = (cu.keys, cu.values,
                                               cu.payload, cu.valid)
                     comb_oflow = comb_oflow + jnp.maximum(
@@ -476,7 +522,8 @@ class DeviceEngine:
                           & (buf_k[:, 1] == SENTINEL))
             local = sorted_unique_reduce(
                 buf_k, buf_v, buf_p, buf_valid, cfg.local_capacity,
-                local_op, unit_values=local_unit, rank_sort=cfg.rank_sort)
+                local_op, unit_values=local_unit, rank_sort=cfg.rank_sort,
+                sort_impl=cfg.sort_impl)
             local_oflow = (map_oflow + comb_oflow
                            + jnp.maximum(local.n_unique
                                          - cfg.local_capacity, 0))
@@ -495,7 +542,8 @@ class DeviceEngine:
 
             fin = sorted_unique_reduce(
                 ex.keys, ex.values, ex.payload, ex.valid, cfg.out_capacity,
-                fin_op, unit_values=False, rank_sort=cfg.rank_sort)
+                fin_op, unit_values=False, rank_sort=cfg.rank_sort,
+                sort_impl=cfg.sort_impl)
             fin_oflow = jnp.maximum(fin.n_unique - cfg.out_capacity, 0)
 
             # LOCAL overflow per device — the host sums across devices
@@ -550,6 +598,9 @@ class DeviceEngine:
             bucket_extra=("wave", _compile_obs.op_token(self.map_fn),
                           _cfg_token(cfg)),
             replay=lambda structs: self._replay_info(cfg, structs),
+            # which compile tier this formulation is (registry schema
+            # v2: buckets record where their best_compile_s came from)
+            tier={"argsort": 0, "variadic": 1}[cfg.sort_impl],
             donate_argnums=_wave_donate_argnums(cfg))
 
     def _get_compiled(self, cfg: EngineConfig):
@@ -557,6 +608,27 @@ class DeviceEngine:
         if key not in self._compiled:
             self._compiled[key] = self._program(cfg)
         return self._compiled[key]
+
+    def _tier_specializer(self):
+        if self._tier_spec is None:
+            from .tiering import TierSpecializer
+
+            self._tier_spec = TierSpecializer()
+        return self._tier_spec
+
+    def _wave_fn(self, cfg: EngineConfig):
+        """The wave-program callable an attempt dispatches: the
+        compiled program itself, or — under ``sort_impl='tiered'`` — a
+        fresh :class:`~.tiering.TieredWaveDispatcher` that serves cold
+        buckets on tier-0 and hot-swaps to tier-1 at a wave boundary.
+        Per-attempt on purpose: a capacity retry re-probes warmness at
+        the NEW capacities and re-enters tier-0 instead of paying the
+        full tier-1 compile mid-retry."""
+        if cfg.sort_impl != "tiered":
+            return self._get_compiled(cfg)
+        from .tiering import TieredWaveDispatcher
+
+        return TieredWaveDispatcher(self, cfg, task=self.task_label)
 
     def _fin_row_avals(self, cfg: EngineConfig, row_shape, row_dtype):
         """Per-partition accumulator row avals — ``[(C,2) u32 keys,
@@ -838,10 +910,14 @@ class DeviceEngine:
         n_records = chunk_rows * T
         record_bytes = 8 + val_bytes + 4 * Q + 1  # key + value + payload
         # the fused fold re-sorts the accumulator rows (out_capacity
-        # running uniques) into every wave's final merge pass
+        # running uniques) into every wave's final merge pass; the
+        # argsort tier additionally pays the second sort pass and the
+        # permutation gathers (tier-0's runtime price)
         return _profile.analytic_costs(input_bytes, n_records,
                                        record_bytes,
-                                       fold_records=cfg.out_capacity)
+                                       fold_records=cfg.out_capacity,
+                                       argsort=(cfg.sort_impl
+                                                == "argsort"))
 
     def precompile(self, row_shape, row_dtype=np.uint8,
                    k: int = None) -> float:
@@ -880,12 +956,18 @@ class DeviceEngine:
         ) + tuple(
             jax.ShapeDtypeStruct((self.n_dev,) + a.shape, a.dtype,
                                  sharding=row_sh)
-            for a in self._fin_row_avals(cfg, row_shape, row_dtype))
+            for a in self._fin_row_avals(_steady_cfg(cfg), row_shape,
+                                         row_dtype))
         if cfg.exchange_stats:
             shapes += (jax.ShapeDtypeStruct(
                 (self.n_dev, self.n_dev), np.int32, sharding=row_sh),)
+        # a 'tiered' policy primes BOTH per-tier programs: a warmed
+        # machine must never fall back to tier-0 serving (the warmness
+        # probe sees the tier-1 bucket and skips tiering outright)
+        cfgs = _tier_cfgs(cfg) if cfg.sort_impl == "tiered" else (cfg,)
         with quiet_unusable_donation():
-            self._get_compiled(cfg).aot(shapes)
+            for c in cfgs:
+                self._get_compiled(c).aot(shapes)
         return time.monotonic() - t0
 
     def stage_inputs(self, chunks: np.ndarray, waves: int = None):
@@ -1012,10 +1094,16 @@ class DeviceEngine:
         t_attempt_compute = 0.0  # final attempt only (the MFU clock)
         retries = 0
         cost_shapes = None  # avals of the dispatched wave (cost model)
+        tiered = cfg.sort_impl == "tiered"
+        #: monotonic instant the FIRST wave program of the run was
+        #: dispatched — run-entry to here is the cold time-to-serving
+        #: the tiered formulation exists to shrink (bench.py gates it
+        #: as cold_first_dispatch_s)
+        t_first_dispatch = None
         try:
             depth = self._max_inflight_programs()
             for attempt in range(max_retries + 1):
-                fn = self._get_compiled(cfg)
+                fn = self._wave_fn(cfg)
                 # fresh all-invalid accumulator per attempt (capacities
                 # may have grown; the prior attempt's buffers were
                 # donated away wave by wave).  cost_shapes resets with
@@ -1025,7 +1113,8 @@ class DeviceEngine:
                 # attempt's avals would miss the executable cache (a
                 # fresh ~100s compile at bench shapes) and record costs
                 # for a program that never ran.
-                acc = self._acc_init(cfg, row_shape, row_dtype)
+                acc = self._acc_init(_steady_cfg(cfg), row_shape,
+                                     row_dtype)
                 cost_shapes = None
                 t0 = time.monotonic()
                 t_blocked = 0.0
@@ -1131,6 +1220,8 @@ class DeviceEngine:
                             # the running uniques threaded through as
                             # donated args (out[:4] reuse their buffers)
                             out = fn(ci, ii, n_real, *acc)
+                            if t_first_dispatch is None:
+                                t_first_dispatch = time.monotonic()
                             _DISPATCHES.inc(1, program="wave",
                                             task=self.task_label)
                             wave_oflows.append(out[4])
@@ -1190,7 +1281,9 @@ class DeviceEngine:
                 # event carrying the attempt's program footprint and the
                 # live device-memory state, so `cli diagnose` can say
                 # whether the retry was HBM-bound or merely out-sized
-                pm = (self._program_memory(cfg, cost_shapes)
+                pm = (self._program_memory(
+                          fn.effective_cfg if tiered else cfg,
+                          cost_shapes)
                       if cost_shapes is not None else None)
                 _memory_obs.capacity_retry_event(
                     task=self.task_label, attempt=attempt,
@@ -1292,8 +1385,13 @@ class DeviceEngine:
         # attempt ran a differently-sized program whose flops aren't the
         # ones counted.
         derived = {}
+        # a tiered run's cost/memory models lower the config of the
+        # tier that actually dispatched last — the ledger's aot() then
+        # re-serves the exact executable the run used, never a fresh
+        # compile of the other tier
+        cost_cfg = fn.effective_cfg if tiered else cfg
         if cost_shapes is not None:
-            costs = self._program_costs(cfg, cost_shapes)
+            costs = self._program_costs(cost_cfg, cost_shapes)
             derived = _profile.record_run(
                 costs, waves=W, compute_s=t_attempt_compute,
                 n_dev=self.n_dev,
@@ -1302,7 +1400,7 @@ class DeviceEngine:
             # per-program HBM footprint rides the same timings dict the
             # cost model does, so the stats doc / statusz per-task
             # stats carry it (obs/memory publishes the gauges)
-            mem = self._program_memory(cfg, cost_shapes)
+            mem = self._program_memory(cost_cfg, cost_shapes)
             derived["program_memory_bytes"] = int(mem.get("total", 0))
             derived["memory_source"] = mem.get("source", "measured")
             sav = _memory_obs.donation_savings(
@@ -1315,6 +1413,16 @@ class DeviceEngine:
             timings["upload_overlap_frac"] = round(overlap, 4)
             timings["waves"] = W
             timings["retries"] = retries
+            if t_first_dispatch is not None:
+                # run-entry -> first wave program dispatched: the cold
+                # serving latency (covers compile of whichever tier
+                # served wave 0 plus its upload)
+                timings["first_dispatch_s"] = round(
+                    t_first_dispatch - t_start, 3)
+            if tiered:
+                timings["tier_swaps"] = fn.swaps
+                timings["tier_cold_start"] = fn.cold
+                timings["serving_tier"] = fn.tier
             if feeder is not None:
                 # the HBM-bound witness: peak bytes of input waves ever
                 # held at once (~STREAM_PREFETCH waves), vs the corpus
@@ -1355,7 +1463,8 @@ def replay_registry(mesh: Mesh, registry_dir: str = None) -> list:
     buckets = LEDGER.disk_buckets(registry_dir)
     engines: dict = {}
     for bucket, rec in sorted(buckets.items()):
-        row = {"bucket": bucket, "program": rec.get("program")}
+        row = {"bucket": bucket, "program": rec.get("program"),
+               "tier": rec.get("tier")}
         replay = rec.get("replay")
         if not isinstance(replay, dict) or \
                 replay.get("kind") != "device_engine":

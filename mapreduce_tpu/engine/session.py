@@ -46,7 +46,8 @@ from ..obs import metrics as _obs
 from ..obs import slo as _slo
 from ..utils.jax_compat import quiet_unusable_donation
 from .device_engine import (
-    AXIS, DeviceEngine, DeviceResult, EngineConfig, _DISPATCHES, _WAVES)
+    AXIS, DeviceEngine, DeviceResult, EngineConfig, _DISPATCHES, _WAVES,
+    _steady_cfg)
 
 _FEEDS = _obs.counter(
     "mrtpu_session_feeds_total",
@@ -58,8 +59,12 @@ _CHUNKS = _obs.counter(
 _SESSION_WAVES = _obs.counter(
     "mrtpu_session_waves_total",
     "fused wave programs dispatched by the session layer (labels: "
-    "task) — the bench smoke asserts device dispatches match this "
-    "one-for-one while the session is the only engine user")
+    "task, tier=0|1|-) — the bench smoke asserts device dispatches "
+    "match this one-for-one while the session is the only engine "
+    "user.  Under sort_impl='tiered' the tier label attributes a cold "
+    "tenant's first waves to tier-0 serving (the SLO plane's "
+    "compile-stall-vs-serving discriminator); '-' is an untiered "
+    "session")
 _SNAPSHOTS = _obs.counter(
     "mrtpu_session_snapshots_total",
     "mid-stream consistent reads of a session aggregate (labels: task)")
@@ -190,6 +195,12 @@ class EngineSession:
         self._row_dtype = None
         self._streams: Dict[str, _Stream] = {}
         self._lock = threading.Lock()
+        #: ONE wave dispatcher for the session's lifetime (tiered
+        #: configs): the session has one program shape, so the tier
+        #: decision and the hot swap happen once per PROGRAM — a swap
+        #: can land between feeds or mid-feed at a wave boundary, and
+        #: every stream (tenant) benefits the moment it does
+        self._dispatcher = None
         _SESSIONS.add(self)
 
     # -- shape latching ----------------------------------------------------
@@ -230,10 +241,20 @@ class EngineSession:
     def _stream(self, task: str) -> _Stream:
         st = self._streams.get(task)
         if st is None:
-            acc = self.engine._acc_init(self.config, self._row_shape,
+            acc = self.engine._acc_init(_steady_cfg(self.config),
+                                        self._row_shape,
                                         self._row_dtype)
             st = self._streams[task] = _Stream(acc)
         return st
+
+    def _wave_fn(self):
+        """The session's wave callable: the compiled program, or (for
+        ``sort_impl='tiered'``) the session-lifetime tiered dispatcher."""
+        if self.config.sort_impl != "tiered":
+            return self.engine._get_compiled(self.config)
+        if self._dispatcher is None:
+            self._dispatcher = self.engine._wave_fn(self.config)
+        return self._dispatcher
 
     def feed(self, chunks: np.ndarray, task: Optional[str] = None,
              on_overflow: str = "raise") -> int:
@@ -267,8 +288,15 @@ class EngineSession:
             # the mask boundary: chunk indices >= n_real are padding
             # (this feed's pad rows AND nothing of a later feed)
             n_real = jax.device_put(np.int32(st.pos + S), rep)
-            fn = eng._get_compiled(self.config)
+            fn = self._wave_fn()
+            # the tier label is a DISPATCH-POLICY fact, so only the
+            # tiered dispatcher's tier counts: an untiered session's
+            # compiled program also carries a .tier (its formulation),
+            # but labelling a plain argsort session "0" would read as
+            # cold serving on every SLO dashboard forever
+            tiered = self.config.sort_impl == "tiered"
             feed_oflow = 0
+            wave_tiers: Dict[str, int] = {}
             try:
                 with quiet_unusable_donation():
                     for w in range(W):
@@ -285,6 +313,14 @@ class EngineSession:
                                       dtype=np.int32), sharded)
                         out = fn(ci, ii, n_real, *st.acc)
                         _DISPATCHES.inc(1, program="wave", task=task)
+                        # per-wave serving tier ("-" untiered): a feed
+                        # that spans the hot swap counts waves under
+                        # both labels, which is exactly the record the
+                        # SLO plane attributes a cold tenant's first
+                        # snapshot with
+                        tier_label = str(fn.tier) if tiered else "-"
+                        wave_tiers[tier_label] = (
+                            wave_tiers.get(tier_label, 0) + 1)
                         # lanes 0-3 records, lane 6+ traffic — the next
                         # wave's carry; lane 4 is the overflow readback
                         # that also proves the wave finished (bounding
@@ -309,7 +345,8 @@ class EngineSession:
             # reflects arrived NOW (all of this feed's waves folded)
             st.last_feed_monotonic = time.monotonic()
             _WAVES.inc(W, task=task)
-            _SESSION_WAVES.inc(W, task=task)
+            for tier_label, n in wave_tiers.items():
+                _SESSION_WAVES.inc(n, task=task, tier=tier_label)
             _FEEDS.inc(task=task)
             _CHUNKS.inc(S, task=task)
             if feed_oflow:

@@ -1,0 +1,286 @@
+"""Tiered wave compilation: serve cold shape buckets immediately.
+
+The fused wave program's cold compile is the engine's worst latency
+number (the ``lax.sort`` comparator dominates it on TPU — ~100s at
+bench shapes; README "Compile latency"), and the two known programs
+trade off against each other: the two-pass stable-argsort formulation
+compiles ~3x faster but *runs* ~2.6x slower.  The classic tiered-JIT
+answer gets both (``EngineConfig.sort_impl = 'tiered'``):
+
+* **tier-0** — the argsort formulation (``sort_impl='argsort'``):
+  built and dispatched IMMEDIATELY on a cold shape bucket, so the
+  first records flow in the time of the fast compile, not the full
+  one;
+* **tier-1** — the variadic formulation (``sort_impl='variadic'``):
+  compiled by ONE background thread per engine through the compile
+  ledger's ``aot()`` (so the ledger, shape registry and cost model see
+  it exactly once, like any other compile), and hot-swapped in at a
+  wave boundary.  The two programs are bit-identical by ``lax.sort``
+  stability and share the donated accumulator layout, so the carry
+  threads straight through the swap and the swap is invisible in
+  results (the golden suite pins it).
+
+Warm buckets — the ledger's in-process executable cache or the on-disk
+shape registry next to an enabled persistent cache already knows the
+tier-1 bucket — go straight to tier-1 and nothing changes.
+
+Failure containment: a tier-1 specialization failure is logged and
+counted, and tier-0 simply keeps serving — background compilation can
+never raise into a run or a session feed.
+
+Monotonic-only module (AST-linted): the swap marker and specialize
+spans are tracer timestamps.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import replace
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from ..obs import metrics as _obs
+from ..obs.trace import TRACER
+from ..utils.jax_compat import quiet_unusable_donation
+
+logger = logging.getLogger("mapreduce_tpu.engine.tiering")
+
+_TIER_DISPATCHES = _obs.counter(
+    "mrtpu_compile_tier_total",
+    "wave-program dispatches by compile tier (labels: program, "
+    "tier=0|1, task) — under sort_impl='tiered', tier=0 dispatches are "
+    "the fast-compile argsort program serving a cold bucket while "
+    "tier-1 specializes in the background")
+_TIER_SWAPS = _obs.counter(
+    "mrtpu_tier_swaps_total",
+    "mid-run tier-0 -> tier-1 hot swaps at a wave boundary (labels: "
+    "program, task); a forced-cold run swaps exactly once")
+_TIER_COLD = _obs.counter(
+    "mrtpu_tier_cold_starts_total",
+    "tiered dispatches that found the steady-state bucket cold and "
+    "served tier-0 first (labels: program, task) — the SLO plane's "
+    "witness that a cold tenant's first snapshot was tier-0 serving, "
+    "not a compile stall")
+_TIER_FAILED = _obs.counter(
+    "mrtpu_tier_specialize_failures_total",
+    "background tier-1 specializations that failed (labels: program); "
+    "tier-0 keeps serving — every one of these is a run stuck at "
+    "tier-0 throughput")
+
+#: test seam: force the warmness probe to report cold, so the tiered
+#: path is exercisable deterministically even when a developer shell
+#: exports a warm $JAX_COMPILATION_CACHE_DIR (the PR-8 smoke lesson) or
+#: an earlier test already compiled the same bucket in-process.
+_FORCE_COLD = False
+
+
+class force_cold:
+    """Context manager (tests / bench smoke): treat every tiered
+    warmness probe as cold for the duration."""
+
+    def __enter__(self):
+        global _FORCE_COLD
+        self._prev = _FORCE_COLD
+        _FORCE_COLD = True
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE_COLD
+        _FORCE_COLD = self._prev
+        return False
+
+
+class TierSpecializer:
+    """ONE background compile thread per engine.
+
+    ``submit`` records the LATEST wanted target; the worker thread
+    compiles targets one at a time through ``LedgeredJit.aot`` (the
+    ledger observes the compile exactly like a foreground one) and
+    parks each finished executable under its target key.  A retry that
+    re-targets mid-compile therefore never runs two ~100s compiles
+    concurrently: the in-flight compile finishes (its executable still
+    lands in the ledger for whoever hits that shape later), then the
+    thread moves on to the newest target — the new capacities.
+    """
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        self._target: Optional[Tuple[Any, Any, Tuple[Any, ...]]] = None
+        self._ready: Dict[Any, Any] = {}
+        self._failed: Dict[Any, str] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, key: Any, fn1: Any,
+               structs: Sequence[Any]) -> None:
+        """Ask for *fn1* compiled at *structs*; *key* identifies the
+        target (the tier-1 config's cache key + shape fingerprint).
+        Later submits supersede earlier ones that haven't started."""
+        with self._cv:
+            if key in self._ready or key in self._failed:
+                return
+            self._target = (key, fn1, tuple(structs))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="mrtpu-tier1-specializer")
+                self._thread.start()
+            self._cv.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._target is None:
+                    self._thread = None
+                    self._cv.notify_all()
+                    return
+                key, fn, structs = self._target
+            err = None
+            compiled = None
+            t0 = time.monotonic()
+            try:
+                with quiet_unusable_donation():
+                    compiled = fn.aot(structs)
+            except Exception as exc:
+                # str(exc), never the live exception (the obs/compile
+                # retained-LogRecord trap); tier-0 keeps serving
+                err = str(exc)
+                logger.warning(
+                    "background tier-1 specialization of %s failed "
+                    "(%s); tier-0 keeps serving", fn.program, err)
+                _TIER_FAILED.inc(program=fn.program)
+            TRACER.record("tier1_specialize", t0, time.monotonic(),
+                          program=fn.program,
+                          outcome="failed" if err else "ok")
+            with self._cv:
+                if err is None:
+                    self._ready[key] = compiled
+                else:
+                    self._failed[key] = err
+                if self._target is not None and self._target[0] == key:
+                    self._target = None
+                self._cv.notify_all()
+
+    def ready(self, key: Any) -> Optional[Any]:
+        """The compiled tier-1 executable for *key*, or None while the
+        background compile is still running (or after it failed)."""
+        with self._cv:
+            return self._ready.get(key)
+
+    def failed(self, key: Any) -> Optional[str]:
+        with self._cv:
+            return self._failed.get(key)
+
+    def target_key(self) -> Optional[Any]:
+        """The key currently being (or about to be) compiled — the
+        retry regression test's witness that a resize re-targeted the
+        specializer at the NEW capacities."""
+        with self._cv:
+            return self._target[0] if self._target is not None else None
+
+    def wait(self, key: Any, timeout: Optional[float] = None) -> bool:
+        """Block until *key*'s compile finished (either way).  Tests
+        and the bench smoke use this to make the swap deterministic;
+        the serving path never calls it."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cv:
+            while key not in self._ready and key not in self._failed:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+
+class TieredWaveDispatcher:
+    """The wave-program callable for ``sort_impl='tiered'``.
+
+    Drop-in where the engine dispatched its compiled wave program: the
+    first call probes the ledger's warmness for the tier-1 bucket at
+    the actual argument shapes — warm goes straight to tier-1
+    (nothing changes), cold builds+dispatches tier-0 NOW and hands
+    tier-1 to the engine's background specializer.  Every later call
+    is a wave boundary: if the specialized executable landed, the
+    dispatcher hot-swaps (counted + a ``tier_swap`` tracer marker) and
+    the donated accumulator carries straight through — the two
+    programs share its layout bit-for-bit.
+
+    One dispatcher per batch attempt (a capacity retry re-probes at
+    the NEW capacities, re-entering tier-0 rather than stalling the
+    retry on the full compile) and one per session (the stream keeps
+    its tier across feeds, so a swap happens once per program, not
+    once per feed).
+    """
+
+    def __init__(self, engine: Any, cfg: Any, task: str = "-") -> None:
+        if cfg.sort_impl != "tiered":
+            raise ValueError(f"TieredWaveDispatcher needs "
+                             f"sort_impl='tiered', got {cfg.sort_impl!r}")
+        self._engine = engine
+        self._cfg0 = replace(cfg, sort_impl="argsort")
+        self._cfg1 = replace(cfg, sort_impl="variadic")
+        self._fn1 = engine._get_compiled(self._cfg1)
+        self._fn0: Optional[Any] = None  # built only when actually cold
+        self._task = task or "-"
+        self._key: Optional[Any] = None
+        #: serving tier: None until the first dispatch decides, then
+        #: 0 (argsort serving) or 1 (steady state)
+        self.tier: Optional[int] = None
+        self.swaps = 0
+        self.cold = False
+
+    @property
+    def effective_cfg(self):
+        """The concrete config of the tier that dispatched last — what
+        the cost/memory models should lower (their ``aot()`` re-serves
+        the exact executable the run used)."""
+        return self._cfg0 if self.tier == 0 else self._cfg1
+
+    def _decide(self, args: Tuple[Any, ...]) -> None:
+        from ..obs.compile import fingerprint
+
+        # the ledger's own leaf->ShapeDtypeStruct builder and its
+        # fingerprint (which keeps shardings as objects — the rule
+        # obs/compile._leaf_fp documents) so the target key can never
+        # drift from the executable cache's notion of a signature
+        structs = self._fn1._structs(args)
+        warmness = ("cold" if _FORCE_COLD
+                    else self._fn1.warmness(structs))
+        if warmness != "cold":
+            # cached executable or persistent-cache bucket: tier-1's
+            # first dispatch is cheap — the warm path is unchanged
+            self.tier = 1
+            return
+        self.tier = 0
+        self.cold = True
+        self._fn0 = self._engine._get_compiled(self._cfg0)
+        self._key = (self._cfg1.cache_key(), fingerprint(structs))
+        _TIER_COLD.inc(program="wave", task=self._task)
+        self._engine._tier_specializer().submit(self._key, self._fn1,
+                                                structs)
+
+    def _maybe_swap(self) -> None:
+        compiled = self._engine._tier_specializer().ready(self._key)
+        if compiled is None:
+            return
+        # hot swap at the wave boundary: the accumulator layout is
+        # identical across tiers, so the donated carry threads through
+        self.tier = 1
+        self.swaps += 1
+        _TIER_SWAPS.inc(program="wave", task=self._task)
+        t = time.monotonic()
+        TRACER.record("tier_swap", t, t, program="wave",
+                      task=self._task, tier_from=0, tier_to=1)
+
+    def __call__(self, *args: Any) -> Any:
+        if self.tier is None:
+            self._decide(args)
+        elif self.tier == 0:
+            self._maybe_swap()
+        fn = self._fn1 if self.tier == 1 else self._fn0
+        out = fn(*args)
+        _TIER_DISPATCHES.inc(program="wave", tier=str(self.tier),
+                             task=self._task)
+        return out

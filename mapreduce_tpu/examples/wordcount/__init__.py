@@ -81,7 +81,11 @@ def device_config():
         exchange_capacity=int(_conf.get("device_exchange_capacity",
                                         1 << 15)),
         out_capacity=int(_conf.get("device_out_capacity", 1 << 17)),
-        tile=512, tile_records=128, reduce_op="sum", unit_values=True)
+        tile=512, tile_records=128, reduce_op="sum", unit_values=True,
+        # 'tiered' serves a cold machine on the fast-compiling argsort
+        # tier while the variadic program builds in the background
+        # (cli wordcount --device --sort-impl)
+        sort_impl=str(_conf.get("device_sort_impl", "variadic")))
 
 
 def device_prepare(pairs, mesh):

@@ -285,7 +285,13 @@ class CompileLedger:
             buckets[bucket] = merged
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"kind": "mrtpu-shape-registry", "version": 1,
+                # schema v2: records MAY carry a tier (the tiered wave
+                # programs do) — and since sort_impl is part of the
+                # bucket id, a bucket's every compile (best_compile_s
+                # included) comes from that one tier.  The loader
+                # accepts v1 files unchanged — the field just reads as
+                # absent.
+                json.dump({"kind": "mrtpu-shape-registry", "version": 2,
                            "buckets": buckets}, f, indent=1,
                           default=float)
             os.replace(tmp, path)
@@ -329,13 +335,36 @@ class CompileLedger:
             self._disk_count_cache = (path, mtime, n)
         return n
 
+    # -- warmness probe (the tiered-dispatch policy input) -------------------
+
+    def warmness(self, program: str, key: Any, arg_structs: Sequence[Any],
+                 bucket_extra: Sequence[Any] = ()) -> str:
+        """How warm one (program, key, shapes) bucket is WITHOUT
+        compiling anything: ``"cached"`` (the in-process executable LRU
+        would serve it outright), ``"persistent"`` (a configured
+        persistent cache already holds the bucket per the on-disk shape
+        registry, so the backend compile would be a fast
+        deserialization), or ``"cold"`` (a fresh backend compile — the
+        case the tiered engine serves on tier-0 while tier-1 builds in
+        the background)."""
+        sig = fingerprint(arg_structs)
+        with self._lock:
+            if (program, key, sig) in self._execs:
+                return "cached"
+        cdir = cache_dir()
+        if cdir and bucket_id(program, arg_structs,
+                              bucket_extra) in self.disk_buckets(cdir):
+            return "persistent"
+        return "cold"
+
     # -- the instrumented helper -------------------------------------------
 
     def compile(self, jitted: Any, arg_structs: Sequence[Any], *,
                 program: str, key: Any = None,
                 donate_argnums: Sequence[int] = (),
                 replay: Optional[Dict[str, Any]] = None,
-                bucket_extra: Sequence[Any] = ()) -> Tuple[Any, str]:
+                bucket_extra: Sequence[Any] = (),
+                tier: Optional[int] = None) -> Tuple[Any, str]:
         """Acquire the compiled executable for *jitted* at
         *arg_structs*, instrumented.  Returns ``(compiled, outcome)``.
 
@@ -413,6 +442,11 @@ class CompileLedger:
             "count": 1,
             "outcomes": {outcome: 1},
         }
+        if tier is not None:
+            # which compile tier produced this bucket (0 = fast-compile
+            # argsort serving tier, 1 = steady-state variadic) — the
+            # registry's schema-v2 field; v1 registries simply lack it
+            record["tier"] = int(tier)
         if donation is not None:
             record["donation"] = donation
         if replay is not None:
@@ -508,6 +542,7 @@ class LedgeredJit:
                                            Optional[Dict[str, Any]]]]
                  = None,
                  bucket_extra: Sequence[Any] = (),
+                 tier: Optional[int] = None,
                  **jit_kw: Any) -> None:
         import jax
 
@@ -517,9 +552,20 @@ class LedgeredJit:
         self._key = key
         self._replay = replay
         self._bucket_extra = tuple(bucket_extra)
+        #: compile tier this program belongs to (0 = argsort serving
+        #: tier, 1 = steady-state variadic, None = untiered) — recorded
+        #: on its shape-registry buckets
+        self.tier = tier
         self._donate = tuple(jit_kw.get("donate_argnums") or ())
         self._compiled: Dict[Any, Any] = {}
         self._plain: set = set()
+
+    def warmness(self, structs: Sequence[Any]) -> str:
+        """The ledger's :meth:`CompileLedger.warmness` for THIS program
+        at *structs* — ``cached`` / ``persistent`` / ``cold``."""
+        key = self._key if self._key is not None else self._jit
+        return self._ledger.warmness(self.program, key, tuple(structs),
+                                     self._bucket_extra)
 
     def _structs(self, args: Tuple[Any, ...]):
         import jax
@@ -583,7 +629,8 @@ class LedgeredJit:
             compiled, _outcome = self._ledger.compile(
                 self._jit, structs, program=self.program,
                 key=self._key, donate_argnums=self._donate,
-                replay=replay_doc, bucket_extra=self._bucket_extra)
+                replay=replay_doc, bucket_extra=self._bucket_extra,
+                tier=self.tier)
         except Exception as exc:
             logger.warning(
                 "instrumented compile of %s failed (%s); plain jit "

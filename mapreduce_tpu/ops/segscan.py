@@ -104,7 +104,8 @@ class SortedUnique(NamedTuple):
 def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
                          valid: jax.Array, capacity: int,
                          op, unit_values: bool = False,
-                         rank_sort: bool = True) -> SortedUnique:
+                         rank_sort: bool = True,
+                         sort_impl: str = "variadic") -> SortedUnique:
     """Group-by-key reduction for LARGE record batches: one sort, then
     shifted-compare run boundaries, a segmented scan (or run-length
     count when ``unit_values``), and gather-based compaction of the run
@@ -119,12 +120,35 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
     ``[k1, k2, iota]`` — three lanes whatever the value/payload arity —
     and the value/payload lanes are permuted afterwards by gathers.
     This decouples the ``lax.sort`` comparator (whose cold compile
-    dominates the engine's ~100s compile at bench shapes and whose
+    dominates the engine's cold compile at bench shapes and whose
     runtime grows with every carried operand) from the record width.
     ``lax.sort`` is stable, so the rank permutation reorders the lanes
     bit-identically to the variadic sort; ``rank_sort=False`` keeps the
     old variadic path for the golden-equivalence suite.
+
+    ``sort_impl`` picks the permutation program itself:
+
+    * ``"variadic"`` (default) — ONE 2-key sort of ``[k1, k2, ...]``
+      (lane transport per ``rank_sort`` above); the steady-state
+      tier-1 program: best runtime, worst comparator compile.
+    * ``"argsort"`` — TWO stable 1-key sorts, each carrying only
+      ``[key_lane, perm]``: sort by ``k2`` first, then stably by
+      ``k1``.  ``lax.sort`` stability makes the composed permutation
+      exactly the 2-key sort's permutation — equal-``k1`` rows keep
+      ascending-``k2`` order, and equal ``(k1, k2)`` pairs keep input
+      order — so the result is BIT-identical to the variadic path
+      (the golden suite pins it).  The rank-sort trick applied to
+      *compile* time: the comparator cost scales with num_keys ×
+      operand count, and 1 key / 2 operands lowers ~3x faster than
+      2 keys / 3 — the tier-0 program the tiered engine serves cold
+      buckets on, at the cost of the extra permutation gathers
+      (measured ~2.6x slower end to end at bench shapes, which is why
+      it is a serving tier and not the steady state).
     """
+    if sort_impl not in ("variadic", "argsort"):
+        raise ValueError(f"sort_impl must be 'variadic' or 'argsort' "
+                         f"here, got {sort_impl!r} (the 'tiered' policy "
+                         "is resolved by the engine before tracing)")
     if isinstance(op, str):
         try:
             op = {"sum": jnp.add, "min": jnp.minimum,
@@ -147,7 +171,19 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
     else:
         v2 = values if values.ndim == 2 else values[:, None]
         n_val_lanes = v2.shape[1]
-    if rank_sort:
+    if sort_impl == "argsort":
+        # tier-0: two-pass stable argsort — each pass sorts ONE key
+        # lane plus the running permutation (2 operands, 1 key), and
+        # stability composes them into the exact 2-key permutation
+        iota = jnp.arange(N, dtype=jnp.int32)
+        _k2s, p1 = jax.lax.sort((k2, iota), num_keys=1)
+        k1s, perm = jax.lax.sort((k1[p1], p1), num_keys=1)
+        k2s = k2[perm]
+        v2s = v2[perm] if n_val_lanes else None
+        vals_s = [v2s[:, i] for i in range(n_val_lanes)]
+        pay_s = payload[perm]
+        pays_s = [pay_s[:, i] for i in range(Q)]
+    elif rank_sort:
         iota = jnp.arange(N, dtype=jnp.int32)
         k1s, k2s, perm = jax.lax.sort((k1, k2, iota), num_keys=2)
         v2s = v2[perm] if n_val_lanes else None

@@ -258,7 +258,22 @@ def test_engine_replay_info_recorded_and_replayable(cache_dir):
 
 def test_warmup_cli_replay_and_unwritable_cache(tmp_path, monkeypatch,
                                                 capsys):
+    import mapreduce_tpu.engine as engine_pkg
     from mapreduce_tpu import cli
+    from mapreduce_tpu.engine.device_engine import EngineConfig
+
+    # the test pins the warmup/replay/unwritable-dir plumbing, not a
+    # full default-capacity compile: shrink the capacities cmd_warmup's
+    # DeviceWordCount builds with (the flag/replay path is identical —
+    # the replay spec records, and replays, this small config)
+    real_wc = engine_pkg.DeviceWordCount
+
+    def small_wc(mesh, chunk_len=1 << 22, config=None, **kw):
+        cfg = EngineConfig(local_capacity=512, exchange_capacity=128,
+                           out_capacity=512, tile=512, tile_records=64)
+        return real_wc(mesh, chunk_len=chunk_len, config=cfg, **kw)
+
+    monkeypatch.setattr(engine_pkg, "DeviceWordCount", small_wc)
 
     # cmd_warmup legitimately points the PROCESS-WIDE cache config (it
     # is a CLI entrypoint); the shared test process must get it back

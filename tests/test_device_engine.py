@@ -179,8 +179,22 @@ def test_device_wordcount_overflow_retry(wc_mesh):
     assert 1 <= tm["retries"] <= 2, tm
 
 
+#: right-sized capacities for the wordcount tests whose assertions are
+#: about pipelining/freeing/mesh semantics, NOT capacity sizing: the
+#: _random_text vocabulary is 205 words, so the default 1<<17 sorts
+#: were pure compile wall (~10s/test on this fixture) — the PR-11
+#: streaming-bound right-sizing applied to the rest of the family,
+#: keeping the grown suite inside the 870s tier-1 timeout.  Capacity
+#: behaviour itself is covered by the overflow/retry tests, and
+#: test_device_wordcount_equals_oracle keeps the DEFAULT config path.
+_SMALL_WC_CFG = EngineConfig(local_capacity=1 << 12,
+                             exchange_capacity=1 << 10,
+                             out_capacity=1 << 12,
+                             combine_in_scan=True)
+
+
 def test_device_wordcount_empty(wc_mesh):
-    wc = DeviceWordCount(wc_mesh, chunk_len=1024)
+    wc = DeviceWordCount(wc_mesh, chunk_len=1024, config=_SMALL_WC_CFG)
     assert wc.count_bytes(b"   \n  ") == {}
 
 
@@ -189,7 +203,7 @@ def test_device_wordcount_wave_pipeline(wc_mesh):
     an on-device merge of the per-partition uniques; the answer must be
     identical to the single-wave run and the oracle."""
     data = _random_text(n_words=8000, seed=4)
-    wc = DeviceWordCount(wc_mesh, chunk_len=1024)
+    wc = DeviceWordCount(wc_mesh, chunk_len=1024, config=_SMALL_WC_CFG)
     tm = {}
     got = wc.count_bytes(data, timings=tm, waves=3)
     assert tm["waves"] == 3
@@ -251,7 +265,7 @@ def test_staged_handle_consumed_and_freed(wc_mesh):
     import weakref
 
     data = _random_text(n_words=4000, seed=8)
-    wc = DeviceWordCount(wc_mesh, chunk_len=1024)
+    wc = DeviceWordCount(wc_mesh, chunk_len=1024, config=_SMALL_WC_CFG)
     handle = wc.stage(data, waves=3)
     staged_list, _n_real = handle[2]
     refs = [weakref.ref(a) for pair in staged_list for a in pair]
@@ -303,7 +317,8 @@ def test_device_wordcount_verify_mode_matches_oracle(wc_mesh):
     (min, max); on collision-free text the counts are identical to the
     fast path and the check passes silently."""
     data = _random_text(n_words=4000, seed=6)
-    wc = DeviceWordCount(wc_mesh, chunk_len=2048, verify_collisions=True)
+    wc = DeviceWordCount(wc_mesh, chunk_len=2048, verify_collisions=True,
+                         config=_SMALL_WC_CFG)
     got = wc.count_bytes(data, waves=2)
     assert got == _oracle(data)
 
@@ -338,7 +353,7 @@ def test_device_wordcount_mixed_mesh():
     all devices against data-axis-only block counts (MULTICHIP_r02)."""
     mesh = make_mesh(n_data=4, n_model=2)
     data = _random_text(n_words=3000, seed=3)
-    wc = DeviceWordCount(mesh, chunk_len=2048)
+    wc = DeviceWordCount(mesh, chunk_len=2048, config=_SMALL_WC_CFG)
     got = wc.count_bytes(data, waves=2)  # wave merge on the mixed mesh too
     assert got == _oracle(data)
 

@@ -47,8 +47,15 @@ def _params(files, device=False):
                                   "reducefn", "finalfn")}
     params["combinerfn"] = MODULE
     params["storage"] = f"mem:{uuid.uuid4().hex}"
+    # right-sized device capacities: the fixture corpus has ~25 unique
+    # words, so the default 1<<17 sorts were pure compile wall (the
+    # wordspan test below always sized its own); capacity semantics are
+    # covered by the dedicated overflow/retry tests
     params["init_args"] = {"files": files, "num_reducers": 4,
-                           "device_chunk_len": 2048}
+                           "device_chunk_len": 2048,
+                           "device_local_capacity": 1 << 10,
+                           "device_exchange_capacity": 1 << 8,
+                           "device_out_capacity": 1 << 10}
     if device:
         params["device"] = True
     return params

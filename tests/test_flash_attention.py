@@ -94,7 +94,11 @@ def test_transformer_flash_path_matches_ring():
     """The model-level wiring: cfg.flash=True (interpreted kernel) must
     reproduce the ring path's loss and one SGD step bit-near-exactly."""
     mesh = make_mesh(n_data=1, n_model=1)
-    kw = dict(vocab=64, embed=32, n_layers=2, n_heads=2, head_dim=16,
+    # one layer: the flash/ring equivalence is a per-layer property and
+    # the interpreted kernel's trace time scales with layer count
+    # (suite-budget right-sizing, PR 12); layer STACKING is covered by
+    # the transformer suite's multi-layer trains
+    kw = dict(vocab=64, embed=32, n_layers=1, n_heads=2, head_dim=16,
               ffn=64)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, 64, size=(2, 129)).astype(np.int32)

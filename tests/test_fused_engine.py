@@ -56,6 +56,10 @@ _RANK_CASES = [("sum", 0, False), ("min", 1, False), ("max", 2, False),
 
 @pytest.mark.parametrize("case", range(len(_RANK_CASES)))
 def test_rank_sort_bit_identical_to_variadic(case):
+    """All three sort formulations — variadic all-lanes, rank-sort, and
+    the tier-0 two-pass stable argsort — must agree bit-for-bit: the
+    argsort tier's whole correctness story is lax.sort stability
+    composing the two 1-key passes into the exact 2-key permutation."""
     op, lanes, unit = _RANK_CASES[case]
     rng = np.random.default_rng(100 + case)
     for n, capacity in [(64, 32), (400, 512), (257, 64)]:
@@ -67,13 +71,16 @@ def test_rank_sort_bit_identical_to_variadic(case):
         outs = [sorted_unique_reduce(
             jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(pay),
             jnp.asarray(valid), capacity, op, unit_values=unit,
-            rank_sort=rs) for rs in (True, False)]
-        for field in range(5):
-            a = np.asarray(outs[0][field])
-            b = np.asarray(outs[1][field])
-            assert np.array_equal(a, b), (
-                f"case {case} n={n} cap={capacity} "
-                f"field {outs[0]._fields[field]} diverged")
+            rank_sort=rs, sort_impl=impl)
+            for rs, impl in ((True, "variadic"), (False, "variadic"),
+                             (True, "argsort"))]
+        for other in outs[1:]:
+            for field in range(5):
+                a = np.asarray(outs[0][field])
+                b = np.asarray(other[field])
+                assert np.array_equal(a, b), (
+                    f"case {case} n={n} cap={capacity} "
+                    f"field {outs[0]._fields[field]} diverged")
 
 
 # -- engine fixtures ---------------------------------------------------------
@@ -307,7 +314,10 @@ def test_exchange_stats_on_off_identical_folds(mesh):
     a multi-wave run of every integer monoid the fold suite covers."""
     rng = np.random.default_rng(23)
     chunks = _chunks(rng, 3 * mesh.shape["data"] * 2)
-    for op in ("sum", "min", "max"):
+    # two monoids (4 engine compiles): the stats lane is a pure side
+    # output with no monoid interaction — the fold golden above keeps
+    # the full sum/min/max/or breadth where the monoid IS the subject
+    for op in ("sum", "min"):
         results = []
         for stats in (True, False):
             cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
@@ -323,6 +333,114 @@ def test_exchange_stats_on_off_identical_folds(mesh):
                 np.asarray(getattr(off, field))
             assert np.array_equal(a, b), (op, field)
         assert _result_dict(on) == _dict_oracle(chunks, op)
+
+
+# -- the argsort tier (tier-0) vs variadic (tier-1), engine level ------------
+
+def test_argsort_tier_bit_identical_engine_folds(mesh):
+    """Pure tier-0 (sort_impl='argsort') multi-wave runs must reproduce
+    pure tier-1 bit-for-bit — the equivalence a mid-run hot swap rests
+    on.  Two monoids at engine scale (each op is two fused-program
+    compiles); the full sum/min/max/custom-stacked/unit_values matrix
+    is pinned compile-free at segscan level by
+    test_rank_sort_bit_identical_to_variadic's 3-way comparison."""
+    rng = np.random.default_rng(53)
+    chunks = _chunks(rng, 3 * mesh.shape["data"] * 2)
+    for op in ("sum", "min"):
+        results = []
+        for impl in ("variadic", "argsort"):
+            cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                               out_capacity=256, reduce_op=op,
+                               sort_impl=impl)
+            res = DeviceEngine(mesh, _records_map_fn, cfg).run(
+                chunks, waves=3, max_retries=0)
+            assert res.overflow == 0
+            results.append(res)
+        tier1, tier0 = results
+        for field in ("keys", "values", "payload", "valid"):
+            a = np.asarray(getattr(tier1, field))
+            b = np.asarray(getattr(tier0, field))
+            assert np.array_equal(a, b), (op, field)
+        assert _result_dict(tier0) == _dict_oracle(chunks, op)
+
+
+def test_argsort_tier_wordcount_unit_values(mesh):
+    """unit_values (the wordcount fast path, one sort operand fewer)
+    through the argsort tier: identical counts to the variadic tier
+    and the host oracle."""
+    data = _random_text(n_words=2000, seed=59)
+    counts = []
+    for impl in ("variadic", "argsort"):
+        wc = DeviceWordCount(
+            mesh, chunk_len=1024,
+            config=EngineConfig(local_capacity=1 << 11,
+                                exchange_capacity=1 << 9,
+                                out_capacity=1 << 11,
+                                combine_in_scan=True,
+                                sort_impl=impl))
+        counts.append(wc.count_bytes(data, waves=2))
+    assert counts[0] == counts[1] == _oracle(data)
+
+
+def test_argsort_tier_overflow_retry_converges(mesh):
+    """The capacity-retry machinery through tier-0: absurd capacities
+    overflow, are counted, right-sized and converge to the oracle —
+    the contract a tiered retry (which re-enters tier-0) relies on."""
+    rng = np.random.default_rng(61)
+    chunks = _chunks(rng, 2 * mesh.shape["data"], r=64)
+    cfg = EngineConfig(local_capacity=16, exchange_capacity=8,
+                       out_capacity=16, reduce_op="sum",
+                       combine_in_scan=True, combine_capacity=4,
+                       sort_impl="argsort")
+    eng = DeviceEngine(mesh, _records_map_fn, cfg)
+    tm = {}
+    res = eng.run(chunks, timings=tm, waves=2)
+    assert tm["retries"] >= 1
+    assert res.overflow == 0
+    assert _result_dict(res) == _dict_oracle(chunks, "sum")
+
+
+def test_midrun_hot_swap_accumulator_golden(mesh):
+    """The tiered tentpole's golden: a run that serves waves 0..k on
+    tier-0 and hot-swaps to tier-1 between waves k and k+1 must yield
+    the SAME accumulator — bit for bit — as a pure tier-0 run and a
+    pure tier-1 run.  The swap point is made deterministic with a stub
+    specializer that reports tier-1 ready at a chosen wave boundary."""
+    from dataclasses import replace
+
+    from tests.test_tiering import _StubSpec
+    from mapreduce_tpu.engine import tiering
+
+    rng = np.random.default_rng(67)
+    chunks = _chunks(rng, 4 * mesh.shape["data"])
+    base = EngineConfig(local_capacity=256, exchange_capacity=64,
+                        out_capacity=256, reduce_op="sum")
+    pures = []
+    for impl in ("variadic", "argsort"):
+        res = DeviceEngine(mesh, _records_map_fn,
+                           replace(base, sort_impl=impl)).run(
+            chunks, waves=4, max_retries=0)
+        assert res.overflow == 0
+        pures.append(res)
+
+    # swap between waves 1 and 2 (poll #2 at wave 2's boundary reports
+    # ready): waves 0-1 tier-0, waves 2-3 tier-1
+    eng = DeviceEngine(mesh, _records_map_fn,
+                       replace(base, sort_impl="tiered"))
+    eng._tier_spec = _StubSpec(after=2)
+    tm = {}
+    with tiering.force_cold():
+        swapped = eng.run(chunks, timings=tm, waves=4, max_retries=0)
+    assert swapped.overflow == 0
+    assert tm["tier_swaps"] == 1 and tm["tier_cold_start"]
+    for pure in pures:
+        for field in ("keys", "values", "payload", "valid"):
+            a = np.asarray(getattr(swapped, field))
+            b = np.asarray(getattr(pure, field))
+            assert np.array_equal(a, b), (
+                f"hot-swapped accumulator diverged from a pure tier "
+                f"on {field}")
+    assert _result_dict(swapped) == _dict_oracle(chunks, "sum")
 
 
 def test_exchange_stats_off_disables_matrix(mesh):

@@ -200,6 +200,12 @@ _MONOTONIC_ONLY_MODULES = {
     os.path.join("mapreduce_tpu", "sched", "service.py"),
     os.path.join("mapreduce_tpu", "engine", "session.py"),
     os.path.join("mapreduce_tpu", "engine", "topk.py"),
+    # the tiered-compilation plane: the tier_swap marker and the
+    # background tier1_specialize spans are tracer timestamps on the
+    # merged timeline — steppable clocks would skew the swap against
+    # the wave spans it must interleave with (the broad-except lint
+    # covers the module automatically, like the whole package)
+    os.path.join("mapreduce_tpu", "engine", "tiering.py"),
     # the serving-SLO plane: burn-rate windows sample on monotonic
     # time and every latency/staleness observation is duration data —
     # a steppable clock would fabricate breaches (its only wall-clock

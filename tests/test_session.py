@@ -208,9 +208,19 @@ def test_session_row_shape_is_latched(mesh):
 _CORPUS_A = b"apple banana apple cherry apple banana date elder " * 40
 _CORPUS_B = b"cherry cherry elder apple fig grape grape " * 25
 
+#: right-sized TopK capacities for this 8-word fixture vocabulary: the
+#: production default (out 1<<16) exists for natural-language streams,
+#: and compiling its sorts here was pure wall — the PR-11/PR-12
+#: right-sizing pattern keeping tier-1 inside its 870s timeout.  The
+#: capacity/overflow machinery keeps its own dedicated tests below.
+_TOPK_CFG = EngineConfig(local_capacity=1 << 11, exchange_capacity=1 << 9,
+                         out_capacity=1 << 12, combine_in_scan=True,
+                         combine_capacity=1 << 9, unit_values=True,
+                         reduce_op="sum")
+
 
 def test_topk_streaming_matches_host_golden(mesh):
-    tk = TopKWords(mesh, k=4, chunk_len=512)
+    tk = TopKWords(mesh, k=4, chunk_len=512, config=_TOPK_CFG)
     tk.feed(_CORPUS_A)
     assert tk.topk() == host_topk(_CORPUS_A, 4)
     tk.feed(_CORPUS_B)  # the stream continues across feeds
@@ -224,7 +234,8 @@ def test_topk_non_tile_multiple_chunk_len(mesh):
     """shard_text rounds the padded row width up to a tile multiple —
     materialisation must use the width it actually produced, not the
     requested one, or every word past row 0 garbles silently."""
-    tk = TopKWords(mesh, k=3, chunk_len=1000)  # row rounds 1512 -> 1536
+    tk = TopKWords(mesh, k=3, chunk_len=1000,  # row rounds 1512 -> 1536
+                  config=_TOPK_CFG)
     tk.feed(_CORPUS_A)
     tk.feed(_CORPUS_B)
     assert tk._L is not None and tk._L % tk.config.tile == 0
@@ -236,12 +247,13 @@ def test_topk_materializing_stream_refuses_int32_offset_wrap(mesh):
     whose global byte offsets would wrap must refuse LOUDLY (garbled
     words with real counts would be silent corruption); hash-only
     streams are unaffected."""
-    tk = TopKWords(mesh, k=2, chunk_len=512)
+    tk = TopKWords(mesh, k=2, chunk_len=512, config=_TOPK_CFG)
     tk.feed(_CORPUS_A)
     tk._L = 2 ** 30  # simulate a stream ~2 GiB in
     with pytest.raises(OverflowError, match="int32"):
         tk.feed(_CORPUS_A)
-    nk = TopKWords(mesh, k=2, chunk_len=512, materialize=False)
+    nk = TopKWords(mesh, k=2, chunk_len=512, materialize=False,
+                   config=_TOPK_CFG)
     nk.feed(_CORPUS_A)
     nk._L = 2 ** 30
     nk.feed(_CORPUS_A)  # hash-only: unbounded by design
@@ -251,7 +263,7 @@ def test_topk_tie_break_is_deterministic(mesh):
     """Equal counts at the K boundary resolve lexicographically — the
     same contract host_topk pins — so the cut cannot flap."""
     corpus = b"zeta alpha mid mid " * 10  # zeta == alpha == 10, mid 20
-    tk = TopKWords(mesh, k=2, chunk_len=512)
+    tk = TopKWords(mesh, k=2, chunk_len=512, config=_TOPK_CFG)
     tk.feed(corpus)
     assert tk.topk() == [(b"mid", 20), (b"alpha", 10)]
 
@@ -274,7 +286,8 @@ def test_topk_batch_rides_capacity_retry(mesh):
 def test_topk_hash_only_mode(mesh):
     """materialize=False retains no host bytes: counts still exact,
     words unresolved (None) — the unbounded-stream mode."""
-    tk = TopKWords(mesh, k=3, chunk_len=512, materialize=False)
+    tk = TopKWords(mesh, k=3, chunk_len=512, materialize=False,
+                   config=_TOPK_CFG)
     tk.feed(_CORPUS_A)
     got = tk.topk()
     want = host_topk(_CORPUS_A, 3)

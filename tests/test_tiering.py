@@ -1,0 +1,407 @@
+"""Tiered wave compilation (engine/tiering): dispatch policy, the
+background specializer, the capacity-retry contract, the registry
+schema bump, and the session/SLO hookup.
+
+The golden *result* equivalences (argsort vs variadic bit-identity,
+mid-run hot-swap accumulator identity) live in tests/test_fused_engine
+with the rest of the fused-program golden suite; this file pins the
+MACHINERY: a cold bucket serves tier-0 immediately, exactly one swap
+happens at a wave boundary once tier-1 lands, a retry during tier-0
+re-enters tier-0 and re-targets the specializer at the NEW capacities,
+a specialization failure never raises into serving, and the shape
+registry records which tier each bucket's best compile came from.
+"""
+
+import threading
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.engine import tiering
+from mapreduce_tpu.engine.device_engine import DeviceEngine, EngineConfig
+from mapreduce_tpu.engine.session import EngineSession
+from mapreduce_tpu.engine.tiering import TierSpecializer
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.obs.trace import TRACER
+from mapreduce_tpu.parallel import make_mesh
+
+from tests.test_fused_engine import (
+    _chunks, _dict_oracle, _records_map_fn, _result_dict)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+_BASE = EngineConfig(local_capacity=256, exchange_capacity=64,
+                     out_capacity=256, reduce_op="sum")
+
+
+def _tier_disp(tier):
+    return REGISTRY.sum("mrtpu_compile_tier_total", tier=tier)
+
+
+# -- the specializer ---------------------------------------------------------
+
+class _FakeFn:
+    """A LedgeredJit stand-in whose aot blocks on an event and records
+    the structs it was asked to compile."""
+
+    program = "wave"
+
+    def __init__(self, gate=None, fail=False):
+        self.gate = gate
+        self.fail = fail
+        self.calls = []
+        self.started = threading.Event()
+
+    def aot(self, structs):
+        self.started.set()
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30)
+        self.calls.append(tuple(structs))
+        if self.fail:
+            raise RuntimeError("synthetic tier-1 compile failure")
+        return ("compiled", tuple(structs))
+
+
+def test_specializer_single_thread_retargets_to_latest():
+    """A submit while the (single) worker is mid-compile supersedes:
+    the in-flight target finishes and lands, then the thread moves on
+    to the NEWEST target — never two concurrent compiles."""
+    gate = threading.Event()
+    spec = TierSpecializer()
+    fn_a = _FakeFn(gate)
+    fn_b = _FakeFn(gate)
+    spec.submit("a", fn_a, ("sa",))
+    # only re-target once the worker is provably INSIDE fn_a's compile
+    assert fn_a.started.wait(timeout=30)
+    spec.submit("b", fn_b, ("sb",))
+    assert spec.ready("a") is None and spec.ready("b") is None
+    gate.set()
+    assert spec.wait("a", timeout=30) and spec.wait("b", timeout=30)
+    assert spec.ready("a") == ("compiled", ("sa",))
+    assert spec.ready("b") == ("compiled", ("sb",))
+    # exactly one worker thread processed both, sequentially
+    assert fn_a.calls == [("sa",)] and fn_b.calls == [("sb",)]
+
+
+def test_specializer_failure_is_contained_and_counted():
+    f0 = REGISTRY.sum("mrtpu_tier_specialize_failures_total")
+    spec = TierSpecializer()
+    spec.submit("bad", _FakeFn(fail=True), ("s",))
+    assert spec.wait("bad", timeout=30)
+    assert spec.ready("bad") is None
+    assert "synthetic" in spec.failed("bad")
+    assert REGISTRY.sum("mrtpu_tier_specialize_failures_total") - f0 == 1
+    # a failed target never un-fails into a retry loop: re-submit is a
+    # no-op (tier-0 keeps serving for this shape)
+    spec.submit("bad", _FakeFn(), ("s",))
+    assert spec.ready("bad") is None
+
+
+# -- dispatch policy ---------------------------------------------------------
+
+class _StubSpec:
+    """Deterministic specializer: ready after N polls (or never)."""
+
+    def __init__(self, after=None):
+        self.after = after  # None = never ready
+        self.polls = 0
+        self.submitted = []
+
+    def submit(self, key, fn1, structs):
+        self.submitted.append((key, tuple(structs)))
+
+    def ready(self, key):
+        self.polls += 1
+        return (object() if self.after is not None
+                and self.polls >= self.after else None)
+
+
+def test_warm_bucket_goes_straight_to_tier1(mesh):
+    """A bucket the ledger already holds (the engine compiled variadic
+    before) must skip tiering outright: zero tier-0 dispatches, zero
+    swaps, zero cold starts — the warm path is unchanged."""
+    rng = np.random.default_rng(31)
+    chunks = _chunks(rng, 2 * mesh.shape["data"])
+    cfg = replace(_BASE, local_capacity=512, out_capacity=512)
+    # warm the variadic bucket the tiered dispatch will probe
+    DeviceEngine(mesh, _records_map_fn, cfg).run(chunks, waves=2,
+                                                 max_retries=0)
+    t0 = _tier_disp("0")
+    c0 = REGISTRY.sum("mrtpu_tier_cold_starts_total")
+    s0 = REGISTRY.sum("mrtpu_tier_swaps_total")
+    eng = DeviceEngine(mesh, _records_map_fn,
+                       replace(cfg, sort_impl="tiered"))
+    tm = {}
+    res = eng.run(chunks, timings=tm, waves=2, max_retries=0)
+    assert res.overflow == 0
+    assert tm["serving_tier"] == 1 and not tm["tier_cold_start"]
+    assert tm["tier_swaps"] == 0
+    assert _tier_disp("0") == t0
+    assert REGISTRY.sum("mrtpu_tier_cold_starts_total") == c0
+    assert REGISTRY.sum("mrtpu_tier_swaps_total") == s0
+    assert eng._tier_spec is None  # no background thread was started
+
+
+def test_cold_run_serves_tier0_and_completes_without_swap(mesh):
+    """Forced cold with tier-1 never landing: every wave serves on
+    tier-0 and the run still completes correctly — background
+    compilation is an optimization, never a dependency."""
+    rng = np.random.default_rng(37)
+    chunks = _chunks(rng, 4 * mesh.shape["data"])
+    eng = DeviceEngine(mesh, _records_map_fn,
+                       replace(_BASE, sort_impl="tiered"))
+    eng._tier_spec = _StubSpec(after=None)  # tier-1 never ready
+    t0 = _tier_disp("0")
+    tm = {}
+    with tiering.force_cold():
+        res = eng.run(chunks, timings=tm, waves=4, max_retries=0)
+    assert res.overflow == 0
+    assert tm["serving_tier"] == 0 and tm["tier_cold_start"]
+    assert tm["tier_swaps"] == 0
+    assert _tier_disp("0") - t0 == 4
+    assert _result_dict(res) == _dict_oracle(chunks, "sum")
+    # the specializer was handed exactly one target: tier-1 at the
+    # dispatch shapes
+    assert len(eng._tier_spec.submitted) == 1
+
+
+def test_capacity_retry_reenters_tier0_and_retargets_specializer(mesh):
+    """Satellite 4: a retry during tier-0 must NOT stall on the tier-1
+    compile — the resized attempt re-enters tier-0 — and the
+    background specializer must be re-targeted at the NEW capacities
+    (the old target's executable would never be dispatched again)."""
+    rng = np.random.default_rng(41)
+    chunks = _chunks(rng, 2 * mesh.shape["data"], r=64)
+    cfg = replace(_BASE, local_capacity=16, exchange_capacity=8,
+                  out_capacity=16, sort_impl="tiered")
+    eng = DeviceEngine(mesh, _records_map_fn, cfg)
+    eng._tier_spec = _StubSpec(after=None)  # tier-1 still compiling
+    t1 = _tier_disp("1")
+    tm = {}
+    with tiering.force_cold():
+        res = eng.run(chunks, timings=tm, waves=2)
+    assert tm["retries"] >= 1
+    assert res.overflow == 0
+    assert _result_dict(res) == _dict_oracle(chunks, "sum")
+    # every dispatch of every attempt served on tier-0
+    assert _tier_disp("1") == t1
+    assert tm["serving_tier"] == 0
+    # one target per attempt, and the retry's target carries the NEW
+    # (right-sized) accumulator shapes — argnum 3 is the [n_dev, C, 2]
+    # key accumulator, C = out_capacity
+    subs = eng._tier_spec.submitted
+    assert len(subs) == tm["retries"] + 1
+    caps = [structs[3].shape[1] for _key, structs in subs]
+    assert caps[0] == 16 and caps[-1] > 16, caps
+    assert len({key for key, _ in subs}) == len(subs)
+
+
+def test_midrun_swap_dispatch_accounting(mesh):
+    """The swap fires at the FIRST wave boundary where tier-1 is ready,
+    exactly once, with one dispatch per wave throughout (result
+    bit-identity across the swap is pinned in test_fused_engine)."""
+    rng = np.random.default_rng(43)
+    chunks = _chunks(rng, 4 * mesh.shape["data"])
+    eng = DeviceEngine(mesh, _records_map_fn,
+                       replace(_BASE, sort_impl="tiered"))
+    eng._tier_spec = _StubSpec(after=2)  # ready at the 2nd poll
+    t0, t1 = _tier_disp("0"), _tier_disp("1")
+    s0 = REGISTRY.sum("mrtpu_tier_swaps_total")
+    tm = {}
+    with tiering.force_cold():
+        res = eng.run(chunks, timings=tm, waves=4, max_retries=0)
+    assert res.overflow == 0
+    # waves 0-1 polled not-ready (decide, poll#1); wave 2 swapped
+    assert tm["tier_swaps"] == 1
+    assert REGISTRY.sum("mrtpu_tier_swaps_total") - s0 == 1
+    assert _tier_disp("0") - t0 == 2
+    assert _tier_disp("1") - t1 == 2
+    assert _result_dict(res) == _dict_oracle(chunks, "sum")
+    # the swap marker landed on the tracer (the same ring /clusterz
+    # merges into the cross-process timeline)
+    swaps = [e for e in TRACER.events() if e.get("name") == "tier_swap"]
+    assert swaps and swaps[-1]["args"]["tier_from"] == 0
+
+
+# -- the session / SLO hookup ------------------------------------------------
+
+class _GatedFn:
+    """Wrap a LedgeredJit so its background aot blocks until released
+    — the deterministic 'tier-1 is still compiling' window."""
+
+    def __init__(self, fn, gate):
+        self._fn = fn
+        self.gate = gate
+        self.program = fn.program
+
+    def aot(self, structs):
+        assert self.gate.wait(timeout=60)
+        return self._fn.aot(structs)
+
+
+def test_cold_session_snapshot_before_tier1_lands(mesh):
+    """Satellite 6: a cold tenant's FIRST snapshot arrives while tier-1
+    is still compiling — served by tier-0, attributed by the tier label
+    on mrtpu_session_waves_total — and the later hot swap is visible on
+    the timeline.  This is the SLO plane's discriminator between
+    'tier-0 serving' and 'compile stall'."""
+    rng = np.random.default_rng(47)
+    n_dev = mesh.shape["data"]
+    chunks = _chunks(rng, 4 * n_dev)
+    gate = threading.Event()
+    spec = TierSpecializer()
+    real_submit = spec.submit
+
+    def gated_submit(key, fn1, structs):
+        real_submit(key, _GatedFn(fn1, gate), structs)
+
+    spec.submit = gated_submit
+    sess = EngineSession(mesh, _records_map_fn,
+                         replace(_BASE, sort_impl="tiered"),
+                         k=2, task="cold-tenant")
+    sess.engine._tier_spec = spec
+    sw0 = REGISTRY.sum("mrtpu_session_waves_total", task="cold-tenant",
+                       tier="0")
+    try:
+        with tiering.force_cold():
+            sess.feed(chunks[:2 * n_dev])
+            # tier-1 is genuinely still compiling (gated) — and the
+            # first snapshot is already serving
+            snap = sess.snapshot()
+        assert spec.ready(sess._dispatcher._key) is None
+        assert sess._dispatcher.tier == 0
+        assert REGISTRY.sum("mrtpu_session_waves_total",
+                            task="cold-tenant", tier="0") - sw0 == 1
+        assert _result_dict(snap) == _dict_oracle(chunks[:2 * n_dev],
+                                                  "sum")
+    finally:
+        gate.set()
+    assert spec.wait(sess._dispatcher._key, timeout=60)
+    s0 = REGISTRY.sum("mrtpu_tier_swaps_total")
+    sess.feed(chunks[2 * n_dev:])  # the next wave boundary: hot swap
+    assert sess._dispatcher.tier == 1
+    assert REGISTRY.sum("mrtpu_tier_swaps_total") - s0 == 1
+    assert REGISTRY.sum("mrtpu_session_waves_total", task="cold-tenant",
+                        tier="1") >= 1
+    assert any(e.get("name") == "tier_swap" for e in TRACER.events())
+    # the stream's aggregate is exact across the swap
+    final = sess.snapshot()
+    assert _result_dict(final) == _dict_oracle(chunks, "sum")
+    sess.close()
+
+
+# -- ledger warmness + registry schema v2 ------------------------------------
+
+def test_ledger_warmness_and_registry_tier_field(mesh, tmp_path,
+                                                 monkeypatch):
+    """warmness() reads cold -> persistent -> cached as the bucket
+    warms through the stack, and the on-disk registry (schema v2)
+    records which tier set best_compile_s — while a v1 registry (no
+    tier fields) still loads and replays."""
+    import jax
+
+    from mapreduce_tpu.obs.compile import LEDGER, registry_path
+
+    cfg = replace(_BASE, local_capacity=128, exchange_capacity=32,
+                  out_capacity=128, sort_impl="argsort")
+    eng = DeviceEngine(mesh, _records_map_fn, cfg)
+    row_sh = (32,)
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        (tmp_path / "cache").mkdir()
+        jax.config.update("jax_compilation_cache_dir",
+                          str(tmp_path / "cache"))
+        fn = eng._get_compiled(cfg)
+        import numpy as _np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n_dev = eng.n_dev
+        shd = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+        structs = (
+            jax.ShapeDtypeStruct((n_dev, 32), _np.int32, sharding=shd),
+            jax.ShapeDtypeStruct((n_dev,), _np.int32, sharding=shd),
+            jax.ShapeDtypeStruct((), _np.int32, sharding=rep),
+        ) + tuple(
+            jax.ShapeDtypeStruct((n_dev,) + a.shape, a.dtype,
+                                 sharding=shd)
+            for a in eng._fin_row_avals(cfg, row_sh, _np.int32)) + (
+            jax.ShapeDtypeStruct((n_dev, n_dev), _np.int32,
+                                 sharding=shd),)
+        assert fn.warmness(structs) == "cold"
+        fn.aot(structs)
+        assert fn.warmness(structs) == "cached"
+        # the disk registry recorded the bucket with its tier (v2)
+        import json
+
+        with open(registry_path()) as f:
+            doc = json.load(f)
+        assert doc["version"] == 2
+        wave = [r for r in doc["buckets"].values()
+                if r["program"] == "wave"]
+        # the bucket's tier IS the tier best_compile_s came from:
+        # sort_impl is part of the bucket id, so one bucket = one tier
+        assert wave and wave[-1]["tier"] == 0
+        assert wave[-1]["best_compile_s"] is not None
+        # a fresh ledger object (same process cache dir): the exec LRU
+        # is empty but the disk bucket exists -> persistent
+        from mapreduce_tpu.obs.compile import CompileLedger
+
+        fresh = CompileLedger()
+        assert fresh.warmness("wave", "other-key", structs,
+                              fn._bucket_extra) == "persistent"
+        # v1 backward compat: strip the v2 field, reload fine
+        for r in doc["buckets"].values():
+            r.pop("tier", None)
+        doc["version"] = 1
+        with open(registry_path(), "w") as f:
+            json.dump(doc, f)
+        buckets = LEDGER.disk_buckets()
+        assert buckets and all(r.get("tier") is None
+                               for r in buckets.values())
+        assert fresh.warmness("wave", "other-key", structs,
+                              fn._bucket_extra) == "persistent"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_warmup_cli_tier_flag_and_summary(tmp_path, capsys, monkeypatch):
+    """cli warmup --tier 0 primes only the argsort program and exits
+    with the per-tier summary naming it."""
+    import jax
+
+    import mapreduce_tpu.engine as engine_pkg
+    from mapreduce_tpu import cli
+    from mapreduce_tpu.obs.compile import LEDGER
+
+    # the test pins the --tier plumbing and the summary, not another
+    # full-size wordcount compile: shrink the capacities cmd_warmup's
+    # DeviceWordCount builds with (the flag path is identical)
+    real_wc = engine_pkg.DeviceWordCount
+
+    def small_wc(mesh, chunk_len=1 << 22, config=None, **kw):
+        cfg = EngineConfig(local_capacity=512, exchange_capacity=128,
+                           out_capacity=512, tile=512, tile_records=64)
+        return real_wc(mesh, chunk_len=chunk_len, config=cfg, **kw)
+
+    monkeypatch.setattr(engine_pkg, "DeviceWordCount", small_wc)
+    # the summary groups the PROCESS ledger's wave buckets: drop the
+    # records earlier tests left so only this warmup's tier shows
+    # (reset only forfeits executable reuse, never correctness)
+    LEDGER.reset()
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        rc = cli.cmd_warmup(["--chunk-len", "2048", "--tier", "0",
+                             "--cache-dir", str(tmp_path / "c")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "per-tier summary:" in out
+        assert "tier 0 (argsort" in out
+        assert "tier 1 (variadic" not in out
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
