@@ -196,7 +196,8 @@ def _setup_logging(verbose: int) -> None:
 
 def cmd_server(argv: List[str]) -> int:
     p = argparse.ArgumentParser(prog="mapreduce_tpu server")
-    p.add_argument("connstr")
+    p.add_argument("connstr",
+                   help="job board connstr (mem://NAME, dir:///PATH, http://HOST:PORT — or the HA replica set http://H1:P1,H2:P2, fails over with the board)")
     p.add_argument("dbname")
     p.add_argument("taskfn")
     p.add_argument("mapfn")
@@ -253,7 +254,8 @@ def cmd_server(argv: List[str]) -> int:
 
 def cmd_worker(argv: List[str]) -> int:
     p = argparse.ArgumentParser(prog="mapreduce_tpu worker")
-    p.add_argument("connstr")
+    p.add_argument("connstr",
+                   help="job board connstr (mem://NAME, dir:///PATH, http://HOST:PORT — or the HA replica set http://H1:P1,H2:P2, fails over with the board)")
     p.add_argument("dbname")
     p.add_argument("--workers", type=int, default=1,
                    help="worker threads in this process")
@@ -621,6 +623,22 @@ def cmd_docserver(argv: List[str]) -> int:
     p.add_argument("--root", default=None,
                    help="back the board with dir://ROOT (durable) "
                         "instead of in-memory")
+    h = p.add_argument_group(
+        "high availability (coord/ha.py: run N replicas over ONE "
+        "shared --ha-dir; the lease holder serves, the rest tail the "
+        "mutation log and answer 421 so clients with a multi-endpoint "
+        "connstr http://H1:P1,H2:P2 fail over; one replica over an "
+        "--ha-dir is simply a durable board)")
+    h.add_argument("--ha-dir", default=None,
+                   help="shared directory holding the board mutation "
+                        "log + primary lease (mutually exclusive with "
+                        "--root)")
+    h.add_argument("--ha-lease", type=float, default=None, metavar="S",
+                   help="board-primary lease period (default 2.0s — "
+                        "the failover detection window)")
+    h.add_argument("--ha-fsync", action="store_true",
+                   help="fsync every log append (survives host/power "
+                        "death, not just process death; slower)")
     g = p.add_argument_group(
         "scheduler admission (the /tasks surface this board hosts; "
         "match --max-inflight on the runner — submits are quota-"
@@ -647,14 +665,22 @@ def cmd_docserver(argv: List[str]) -> int:
         ("tenant_max_queued_jobs", args.tenant_max_queued_jobs),
         ("tenant_max_queued_bytes", args.tenant_max_queued_bytes),
     ) if v is not None}
+    if args.root and args.ha_dir:
+        print("--root and --ha-dir are mutually exclusive (the HA "
+              "board's durable state IS the mutation log)",
+              file=sys.stderr)
+        return 2
     store = DirDocStore(args.root) if args.root else None
     srv = DocServer(store, args.host, args.port, auth_token=args.auth,
                     scheduler_config=(SchedulerConfig(**overrides)
-                                      if overrides else None))
+                                      if overrides else None),
+                    ha_dir=args.ha_dir, ha_lease=args.ha_lease,
+                    ha_fsync=args.ha_fsync)
+    role = f"; HA role: {srv.ha.role}" if srv.ha is not None else ""
     print(f"job board at http://{srv.host}:{srv.port} "
           f"(CONNSTR: \"http://HOST:{srv.port}\"; Prometheus at "
           f"/metrics, cluster snapshot at /statusz, merged cluster "
-          f"timeline at /clusterz)", flush=True)
+          f"timeline at /clusterz{role})", flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -924,10 +950,29 @@ def _render_checkpoint(ck: dict) -> List[str]:
     return out
 
 
+def _render_ha(ha: dict) -> List[str]:
+    """The board-HA section of /statusz (coord/ha.py): role, fencing
+    generation, mutation-log progress."""
+    if not ha:
+        return []
+    lease = ha.get("lease") or {}
+    out = ["board ha: {} (generation {}, holder {}) | log {} appended "
+           "/ {} replayed / {}B (lag {}B) | {} promotion(s)".format(
+               ha.get("role", "?"), ha.get("generation", 0),
+               lease.get("holder") or ha.get("holder") or "-",
+               ha.get("log_appended", 0), ha.get("log_replayed", 0),
+               ha.get("log_bytes", 0), ha.get("replay_lag_bytes", 0),
+               ha.get("promotions", 0))]
+    if ha.get("failed"):
+        out.append(f"  BOARD HA FAILED: {ha['failed']}")
+    return out
+
+
 def render_status(snap: dict) -> str:
     """One-screen text view of a /statusz snapshot (the master status
     page role, Dean & Ghemawat §4.6)."""
     lines: List[str] = _render_build(snap.get("build") or {})
+    lines += _render_ha(snap.get("ha") or {})
     lines += _render_device(snap.get("device") or {})
     lines += _render_compile(snap.get("compile") or {})
     lines += _render_memory(snap.get("memory") or {})
@@ -1012,8 +1057,10 @@ def cmd_status(argv: List[str]) -> int:
     during-the-run window)."""
     p = argparse.ArgumentParser(prog="mapreduce_tpu status")
     p.add_argument("connstr",
-                   help="the docserver, http://HOST:PORT "
-                        "(the same CONNSTR workers use)")
+                   help="the docserver, http://HOST:PORT — or the HA "
+                        "replica set http://H1:P1,H2:P2: the watcher "
+                        "fails over with the board (the same CONNSTR "
+                        "workers use)")
     p.add_argument("--watch", type=float, default=None, metavar="S",
                    help="re-poll every S seconds until interrupted "
                         "(default: render once and exit)")
@@ -1413,7 +1460,9 @@ def cmd_runner(argv: List[str]) -> int:
     docserver serves; submit work with ``cli submit``."""
     p = argparse.ArgumentParser(prog="mapreduce_tpu runner")
     p.add_argument("connstr",
-                   help="the job board (http://HOST:PORT docserver, or "
+                   help="the job board (http://HOST:PORT docserver — "
+                        "or the HA replica set http://H1:P1,H2:P2, the "
+                        "runner fails over with the board — or "
                         "mem://NAME / dir:///PATH for in-process use)")
     p.add_argument("--workers", type=int, default=4,
                    help="cross-tenant worker threads in this process")

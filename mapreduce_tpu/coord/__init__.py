@@ -15,6 +15,8 @@ from .docstore import MemoryDocStore, DirDocStore, connect  # noqa: F401
 from .docserver import DocServer, HttpDocStore  # noqa: F401
 from .connection import Connection  # noqa: F401
 from .task import Task  # noqa: F401
-from .lease import TrainerLease, TrainerFencedError  # noqa: F401
+from .lease import (  # noqa: F401
+    BoardLease, TrainerFencedError, TrainerLease)
+from .ha import HaController, ReplicatedDocStore  # noqa: F401
 from .job import Job  # noqa: F401
 from .persistent_table import PersistentTable  # noqa: F401

@@ -57,17 +57,38 @@ class Connection:
         if self.auth:
             return self.auth
         if self.connstr.startswith("http://"):
-            return split_embedded_token(
-                self.connstr[len("http://"):])[0]
+            # parse per replica endpoint: a token embedded in ANY
+            # member of a multi-endpoint (HA) connstr authenticates
+            # the whole replica set
+            for member in self.connstr[len("http://"):].split(","):
+                token = split_embedded_token(member)[0]
+                if token:
+                    return token
         return None
 
-    def board_hostport(self) -> Optional[str]:
-        """``HOST:PORT`` of an http:// board connstr (ambient-auth scope)."""
+    def board_hostports(self) -> List[str]:
+        """Every ``HOST:PORT`` of an http:// board connstr — one entry
+        per replica of a multi-endpoint (HA) board,
+        ``http://[TOKEN@]H1:P1,H2:P2``.  The ambient-auth scope must
+        cover ALL of them: a client that failed over mid-job still
+        speaks to its own cluster."""
         from ..utils.httpclient import split_embedded_token
 
-        if self.connstr.startswith("http://"):
-            return split_embedded_token(self.connstr[len("http://"):])[1]
-        return None
+        if not self.connstr.startswith("http://"):
+            return []
+        # split members FIRST, token per member second (the auth_token
+        # / FailoverClient parse order): a token embedded in a NON-
+        # first member must not eat the earlier members' addresses
+        return [split_embedded_token(m)[1]
+                for m in self.connstr[len("http://"):].split(",") if m]
+
+    def board_hostport(self) -> Optional[str]:
+        """The board address for single-handle consumers (the
+        telemetry pushers): every replica of a multi-endpoint board,
+        comma-joined — the form FailoverClient/acquire_pusher accept —
+        so a pusher follows the primary across a failover."""
+        hps = self.board_hostports()
+        return ",".join(hps) if hps else None
 
     # -- connection -----------------------------------------------------
 

@@ -48,7 +48,8 @@ from ..obs.collector import Collector
 from ..obs.statusz import cluster_status, update_board_gauges
 from ..obs.trace import TRACE_HEADER, TRACER
 from ..utils.httpclient import (
-    KeepAliveClient, RetryPolicy, check_auth, default_auth_token)
+    NOT_PRIMARY_STATUS, FailoverClient, NotPrimaryError, RetryPolicy,
+    check_auth, default_auth_token)
 from .docstore import Doc, DocStore, MemoryDocStore, Query
 
 _REQUESTS = _metrics.counter(
@@ -107,9 +108,23 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     auth_token: Optional[str]  # None = open server
     collector: Collector       # cluster telemetry sink (obs/collector)
     scheduler: Any             # sched.Scheduler hosted on self.store
+    ha: Any = None             # coord/ha.HaController when HA-deployed
 
     def log_message(self, *a):  # quiet
         pass
+
+    def _not_primary(self, length: int) -> None:
+        """Answer a request that needs the primary from a replica that
+        is not (standby, fenced, or mid-takeover): HTTP 421, which is
+        NOT in the clients' retryable-status set — a FailoverClient
+        rotates to the next endpoint immediately instead of burning
+        its budget here."""
+        self.rfile.read(length)
+        _REQUESTS.inc(op="-", outcome="not_primary")
+        self._respond(NOT_PRIMARY_STATUS, json.dumps(
+            {"ok": False, "type": "NotPrimaryError",
+             "error": f"this board replica is {self.ha.role}; dial "
+                      "the lease-holding primary"}).encode())
 
     def _respond(self, code: int, body: bytes,
                  ctype: str = "application/json") -> None:
@@ -120,6 +135,11 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self) -> None:
+        if self.ha is not None and not self.ha.is_primary():
+            # every POST surface mutates or feeds primary-local state;
+            # a replica serves none of them
+            return self._not_primary(
+                int(self.headers.get("Content-Length", 0)))
         if self.path == "/telemetry":
             return self._do_telemetry()
         if self.path == "/tasks":
@@ -151,26 +171,61 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
 
         body = None
         t_exec = time.monotonic()
+        # on a replicated (HA) board, a rid-carrying request runs as a
+        # deferred-log transaction: its mutation entries and recorded
+        # response reach the shared mutation log in ONE atomic append,
+        # so a standby either replays mutation+answer together or sees
+        # neither — the dedupe table survives failover with the state
+        defer = (getattr(self.store, "deferred_rid", None)
+                 if rid is not None else None)
+        txn_ctx = (defer(rid) if defer is not None
+                   else contextlib.nullcontext(None))
+        not_primary = False
         try:
-            # adopt the caller's span (TRACE_HEADER) so this RPC's span
-            # nests under the client-side job/claim trace in Perfetto
-            with TRACER.adopt(self.headers.get(TRACE_HEADER)), \
-                    TRACER.span(f"rpc:{op}", coll=req.get("coll")):
-                result = self._execute(op, req)
-            body = json.dumps({"ok": True, "result": result}).encode()
-            _REQUESTS.inc(op=op, outcome="ok")
-        except Exception as exc:
-            # catch EVERYTHING: a reserved rid must always get a recorded
-            # response, or the client's reconnect-retry would re-execute a
-            # mutation whose first attempt partially applied (e.g. ENOSPC
-            # mid-multi-update on a dir:// board)
-            body = json.dumps({"ok": False, "type": type(exc).__name__,
-                               "error": str(exc)}).encode()
-            _REQUESTS.inc(op=op, outcome="error")
+            with txn_ctx as txn:
+                try:
+                    # adopt the caller's span (TRACE_HEADER) so this
+                    # RPC's span nests under the client-side job/claim
+                    # trace in Perfetto
+                    with TRACER.adopt(self.headers.get(TRACE_HEADER)), \
+                            TRACER.span(f"rpc:{op}",
+                                        coll=req.get("coll")):
+                        result = self._execute(op, req)
+                    body = json.dumps({"ok": True,
+                                       "result": result}).encode()
+                    _REQUESTS.inc(op=op, outcome="ok")
+                except NotPrimaryError:
+                    # the self-fence lapsed BETWEEN the do_POST door
+                    # check and the write path: answer 421 so the
+                    # multi-endpoint client rotates to the standby,
+                    # and record NOTHING for the rid — no mutation
+                    # applied (the fence precedes the apply), so the
+                    # failed-over re-send must execute fresh
+                    not_primary = True
+                    _REQUESTS.inc(op=op, outcome="not_primary")
+                except Exception as exc:
+                    # catch EVERYTHING else: a reserved rid must always
+                    # get a recorded response, or the client's
+                    # reconnect-retry would re-execute a mutation whose
+                    # first attempt partially applied (e.g. ENOSPC mid-
+                    # multi-update on a dir:// board)
+                    body = json.dumps(
+                        {"ok": False, "type": type(exc).__name__,
+                         "error": str(exc)}).encode()
+                    _REQUESTS.inc(op=op, outcome="error")
+                if txn is not None:
+                    txn.body = body
         finally:
             _RPC_SECONDS.observe(time.monotonic() - t_exec, op=op)
             if rid is not None:
+                # body None (not-primary) leaves the rid unrecorded:
+                # waiters wake, the re-send executes on the successor
                 self._record_rid(rid, body)
+        if not_primary:
+            return self._respond(NOT_PRIMARY_STATUS, json.dumps(
+                {"ok": False, "type": "NotPrimaryError",
+                 "error": "primacy lapsed mid-request; rotate"}
+            ).encode())
         self._respond(200, body)
 
     # -- rid dedupe (shared by /rpc and /tasks mutations) -------------------
@@ -233,28 +288,64 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         with self.dedupe_lock:
             ev = self.inflight.pop(rid, None)
             if body is not None:  # BaseException: leave unrecorded
-                self.done[rid] = body
-                while len(self.done) > _DEDUPE_CAP:
-                    old_rid, _ = self.done.popitem(last=False)
-                    # remember the high-water mark of evicted seqs
-                    # per session so a straggler can be refused
-                    # instead of re-applied (seqs are monotonic
-                    # per session, so max == newest evicted)
-                    s, q = _rid_session_seq(old_rid)
-                    if s is not None and q is not None:
-                        self.evicted[s] = max(
-                            q, self.evicted.get(s, -1))
-                        self.evicted.move_to_end(s)
-                        while len(self.evicted) > _SESSION_CAP:
-                            self.evicted.popitem(last=False)
+                self._remember_locked(rid, body)
         if ev is not None:
             ev.set()
+
+    @classmethod
+    def _remember_locked(cls, rid: str, body: bytes) -> None:
+        """Insert one answered rid into the dedupe cache (dedupe_lock
+        HELD), evicting the oldest past the cap into the per-session
+        high-water marks — seqs are monotonic per session, so max ==
+        newest evicted."""
+        cls.done[rid] = body
+        while len(cls.done) > _DEDUPE_CAP:
+            old_rid, _ = cls.done.popitem(last=False)
+            s, q = _rid_session_seq(old_rid)
+            if s is not None and q is not None:
+                cls.evicted[s] = max(q, cls.evicted.get(s, -1))
+                cls.evicted.move_to_end(s)
+                while len(cls.evicted) > _SESSION_CAP:
+                    cls.evicted.popitem(last=False)
+
+    # -- the HA replayer's dedupe feed (coord/ha.py, duck-typed) -----------
+
+    @classmethod
+    def remember_answer(cls, rid: str, body: bytes) -> None:
+        """Seed a REPLAYED rid->response pair (a mutation the old
+        primary answered): a client retry that failed over here
+        replays the recorded answer instead of re-applying."""
+        with cls.dedupe_lock:
+            cls._remember_locked(rid, body)
+
+    @classmethod
+    def refuse_rid(cls, rid: str) -> None:
+        """Mark a rid whose mutations were logged WITHOUT a recorded
+        response (the old primary died mid-request): its retry must be
+        refused with the loud dedupe ambiguity, never re-applied.
+        Rides the eviction watermark — the client allocates seqs
+        monotonically and serializes mutations per handle, so the
+        watermark refuses exactly this rid."""
+        s, q = _rid_session_seq(rid)
+        if s is None or q is None:
+            return
+        with cls.dedupe_lock:
+            cls.evicted[s] = max(q, cls.evicted.get(s, -1))
+            cls.evicted.move_to_end(s)
+            while len(cls.evicted) > _SESSION_CAP:
+                cls.evicted.popitem(last=False)
 
     # -- /tasks: the scheduler surface --------------------------------------
 
     #: /tasks ops whose second application would change state (deduped);
     #: "tick" is idempotent admission work and re-executes harmlessly
     _TASKS_MUTATING = frozenset({"submit", "cancel"})
+    #: serializes ALL /tasks scheduler calls on an HA board: a deferred
+    #: submit/cancel holds the store lock for its whole transaction
+    #: (wrapper -> scheduler lock order) while a concurrent tick takes
+    #: scheduler -> wrapper — this outer lock keeps the two orders from
+    #: ever interleaving (set per-server in DocServer.__init__)
+    tasks_lock: threading.Lock
 
     def _do_tasks(self) -> None:
         """The multi-tenant scheduler surface (sched/scheduler.py):
@@ -281,41 +372,85 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             if answered is not None:
                 return self._respond(200, answered)
         body = None
+        code = 200
         t_exec = time.monotonic()
+        # HA boards: a submit/cancel is a multi-mutation transaction —
+        # defer its log entries so they commit atomically WITH the
+        # recorded response (the do_POST /rpc pattern)
+        defer = (getattr(self.store, "deferred_rid", None)
+                 if rid is not None else None)
+        txn_ctx = (defer(rid) if defer is not None
+                   else contextlib.nullcontext(None))
+        not_primary = False
+        # the tasks_lock guards a lock-order inversion that only exists
+        # on an HA board (a deferred submit holds the store lock for
+        # its whole transaction while a tick takes scheduler->store);
+        # a plain board keeps its concurrent submit/cancel/tick
+        lock_ctx = (self.tasks_lock if self.ha is not None
+                    else contextlib.nullcontext())
         try:
-            if op == "submit":
-                result = self.scheduler.submit(
-                    req["tenant"], db=req.get("db"),
-                    params=req.get("params"),
-                    priority=int(req.get("priority") or 0),
-                    weight=float(req.get("weight") or 1.0),
-                    est_jobs=int(req.get("est_jobs") or 0),
-                    est_bytes=int(req.get("est_bytes") or 0),
-                    kind=req.get("kind") or "server")
-            elif op == "cancel":
-                result = self.scheduler.cancel(
-                    req["task_id"], reason=req.get("reason") or "cancelled")
-            else:
-                result = self.scheduler.tick()
-            body = json.dumps({"ok": True, "result": result}).encode()
-            _REQUESTS.inc(op=f"tasks:{op}", outcome="ok")
-        except Exception as exc:
-            # same contract as /rpc: a reserved rid always gets a
-            # recorded response, and admission rejections travel as
-            # typed errors (QuotaExceededError carries its reason)
-            doc = {"ok": False, "type": type(exc).__name__,
-                   "error": str(exc)}
-            reason = getattr(exc, "reason", None)
-            if reason is not None:
-                doc["reason"] = reason
-            body = json.dumps(doc).encode()
-            _REQUESTS.inc(op=f"tasks:{op}", outcome="error")
+            with lock_ctx, txn_ctx as txn:
+                try:
+                    if op == "submit":
+                        result = self.scheduler.submit(
+                            req["tenant"], db=req.get("db"),
+                            params=req.get("params"),
+                            priority=int(req.get("priority") or 0),
+                            weight=float(req.get("weight") or 1.0),
+                            est_jobs=int(req.get("est_jobs") or 0),
+                            est_bytes=int(req.get("est_bytes") or 0),
+                            kind=req.get("kind") or "server")
+                    elif op == "cancel":
+                        result = self.scheduler.cancel(
+                            req["task_id"],
+                            reason=req.get("reason") or "cancelled")
+                    else:
+                        result = self.scheduler.tick()
+                    body = json.dumps({"ok": True,
+                                       "result": result}).encode()
+                    _REQUESTS.inc(op=f"tasks:{op}", outcome="ok")
+                except NotPrimaryError:
+                    # primacy lapsed mid-transaction: 421 (the client
+                    # rotates), rid left unrecorded — any entries the
+                    # transaction already applied commit WITHOUT a
+                    # response, so the successor refuses the re-send
+                    # loudly instead of double-applying
+                    not_primary = True
+                    _REQUESTS.inc(op=f"tasks:{op}",
+                                  outcome="not_primary")
+                except Exception as exc:
+                    # same contract as /rpc: a reserved rid always gets
+                    # a recorded response, and admission rejections
+                    # travel as typed errors (QuotaExceededError
+                    # carries its reason) — over the wire as HTTP 429,
+                    # which the SchedulerClient deliberately does NOT
+                    # retry: backpressure must reject loudly, not turn
+                    # into a silent retry storm
+                    doc = {"ok": False, "type": type(exc).__name__,
+                           "error": str(exc)}
+                    reason = getattr(exc, "reason", None)
+                    if reason is not None:
+                        doc["reason"] = reason
+                    body = json.dumps(doc).encode()
+                    if type(exc).__name__ == "QuotaExceededError":
+                        code = 429
+                        _REQUESTS.inc(op=f"tasks:{op}",
+                                      outcome="rejected")
+                    else:
+                        _REQUESTS.inc(op=f"tasks:{op}", outcome="error")
+                if txn is not None:
+                    txn.body = body
         finally:
             _RPC_SECONDS.observe(time.monotonic() - t_exec,
                                  op=f"tasks:{op}")
             if rid is not None:
                 self._record_rid(rid, body)
-        self._respond(200, body)
+        if not_primary:
+            return self._respond(NOT_PRIMARY_STATUS, json.dumps(
+                {"ok": False, "type": "NotPrimaryError",
+                 "error": "primacy lapsed mid-request; rotate"}
+            ).encode())
+        self._respond(code, body)
 
     def _do_telemetry(self) -> None:
         """The collector's push sink: workers/servers POST span batches +
@@ -359,6 +494,12 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             return self._respond(404, b"{}")
         if self.path == "/healthz":
             _SCRAPES.inc(path=self.path)
+            if self.ha is not None:
+                # liveness plus ROLE: orchestrator probes and the chaos
+                # suite can tell the primary from a standby without auth
+                return self._respond(200, json.dumps(
+                    {"ok": True, "role": self.ha.role,
+                     "primary": self.ha.is_primary()}).encode())
             return self._respond(200, b'{"ok": true}')
         if not check_auth(self.auth_token, self.headers):
             return self._respond(401, b"{}")
@@ -397,9 +538,12 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                     default=float).encode()
                 ctype = "application/json"
             else:
-                body = json.dumps(cluster_status(
+                snap = cluster_status(
                     self.store, collector=self.collector,
-                    scheduler=self.scheduler)).encode()
+                    scheduler=self.scheduler)
+                if self.ha is not None:
+                    snap["ha"] = self.ha.snapshot()
+                body = json.dumps(snap).encode()
                 ctype = "application/json"
         except Exception as exc:
             # a scrape must never kill the handler thread mid-chaos; the
@@ -455,19 +599,40 @@ class DocServer:
     def __init__(self, store: Optional[DocStore] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  auth_token: Optional[str] = None,
-                 scheduler_config=None) -> None:
+                 scheduler_config=None,
+                 ha_dir: Optional[str] = None,
+                 ha_lease: Optional[float] = None,
+                 ha_fsync: bool = False) -> None:
         # late import: sched builds on coord (no cycle at module load)
         from ..sched.scheduler import Scheduler, SchedulerConfig
 
-        bound_store = store if store is not None else MemoryDocStore()
+        self.ha = None
+        if ha_dir is not None:
+            if store is not None:
+                raise ValueError(
+                    "ha_dir and an explicit store are mutually "
+                    "exclusive: the HA board's authoritative state is "
+                    "the mutation log under ha_dir")
+            from .ha import DEFAULT_BOARD_LEASE, HaController
+
+            self.ha = HaController(
+                ha_dir,
+                lease=(ha_lease if ha_lease is not None
+                       else DEFAULT_BOARD_LEASE),
+                fsync=ha_fsync)
+            bound_store: DocStore = self.ha.store
+        else:
+            bound_store = store if store is not None else MemoryDocStore()
         handler = type("BoundRpcHandler", (_RpcHandler,), {
             "store": bound_store,
             "done": collections.OrderedDict(),
             "inflight": {},
             "evicted": collections.OrderedDict(),
             "dedupe_lock": threading.Lock(),
+            "tasks_lock": threading.Lock(),
             "auth_token": default_auth_token(auth_token),
             "collector": Collector(local_role="server"),
+            "ha": self.ha,
             # every docserver hosts the multi-tenant scheduler surface;
             # admission (tick) stays lease-fenced, so a board whose
             # admission runs in a separate runner process simply never
@@ -479,8 +644,24 @@ class DocServer:
         self.store = handler.store
         self.collector = handler.collector
         self.scheduler = handler.scheduler
-        self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
+        try:
+            self.httpd = http.server.ThreadingHTTPServer((host, port),
+                                                         handler)
+        except OSError:
+            if self.ha is not None:
+                # a replica that cannot serve must not contend for —
+                # let alone hold — the board-primary lease
+                self.ha.log.close()
+            raise
         self.host, self.port = self.httpd.server_address[:2]
+        if self.ha is not None:
+            # bind the HTTP port FIRST: only a replica that can serve
+            # may contend for the lease (a bind failure must not leak
+            # a lease-holding controller that answers nothing).  The
+            # handler's class-level dedupe maps are where replayed rid
+            # answers land.
+            self.ha.bind_handler(handler)
+            self.ha.start()
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -501,38 +682,57 @@ class DocServer:
         if self._thread:
             self._thread.join(timeout=10)
         self.httpd.server_close()
+        if self.ha is not None:
+            # clean handoff: releases the board lease so a standby's
+            # next poll promotes immediately, no expiry wait
+            self.ha.stop()
 
 
 class HttpDocStore(DocStore):
-    """Client DocStore over a :class:`DocServer` (``http://HOST:PORT``).
+    """Client DocStore over a :class:`DocServer` (``http://HOST:PORT``,
+    or the HA replica-set form ``HOST:PORT,HOST:PORT``).
 
-    One keep-alive connection per handle, serialized by a lock (a worker's
-    claim loop and its heartbeat thread share the handle); re-established
-    on a broken socket under the client's :class:`RetryPolicy`, with the
-    request id making every re-send exactly-once for mutating ops.  The
-    rid is ``SESSION:SEQ`` — a per-handle session plus a monotonic
-    sequence — so the server can tell a straggling retry of an *evicted*
-    dedupe entry from a fresh request and fail it loudly instead of
-    silently re-applying (see ``_RpcHandler``).
+    One keep-alive connection per endpoint, serialized by a lock (a
+    worker's claim loop and its heartbeat thread share the handle);
+    re-established on a broken socket under the client's
+    :class:`RetryPolicy`, with the request id making every re-send
+    exactly-once for mutating ops.  With several endpoints the
+    :class:`FailoverClient` rotates on transport failures and on a
+    standby's 421 — the rid is allocated ONCE per logical call, so the
+    re-send a failover triggers replays from the new primary's
+    replicated dedupe table instead of re-applying.  The rid is
+    ``SESSION:SEQ`` — a per-handle session plus a monotonic sequence —
+    so the server can tell a straggling retry of an *evicted* dedupe
+    entry from a fresh request and fail it loudly instead of silently
+    re-applying (see ``_RpcHandler``).
     """
 
     def __init__(self, address: str,
                  auth_token: Optional[str] = None,
                  retry: Optional[RetryPolicy] = None) -> None:
-        self._client = KeepAliveClient.from_address(
+        self._client = FailoverClient(
             address, what="http docstore", auth_token=auth_token,
             retry=retry)
-        self.host, self.port = self._client.host, self._client.port
         self._rid_session = uuid.uuid4().hex
         self._rid_seq = itertools.count(1)
         #: set after a server rejects find_and_modify_many as unknown —
         #: the client then falls back to serial claims for good
         self._no_batched_claims = False
+
         # serializes rid allocation WITH the send: the eviction watermark
         # assumes this session's seqs arrive in order, so two threads
         # sharing the handle (claim loop + heartbeat) must not allocate
         # seqs in one order and win the client's send lock in the other
         self._mutate_lock = threading.Lock()
+
+    # the ACTIVE endpoint's coordinates (rotates under failover)
+    @property
+    def host(self) -> str:
+        return self._client.host
+
+    @property
+    def port(self) -> int:
+        return self._client.port
 
     def _rpc(self, op: str, **fields: Any) -> Any:
         payload: Dict[str, Any] = {"op": op, **fields}
@@ -549,6 +749,13 @@ class HttpDocStore(DocStore):
                 f"docstore rpc {op!r}: auth rejected by "
                 f"{self.host}:{self.port} (set $MAPREDUCE_TPU_AUTH or "
                 "pass auth to Connection)")
+        if status == NOT_PRIMARY_STATUS:
+            # single-endpoint store dialing a standby replica (a multi-
+            # endpoint FailoverClient rotates before this can surface)
+            raise NotPrimaryError(
+                f"docstore rpc {op!r}: {self.host}:{self.port} is a "
+                "standby board replica — pass every replica in the "
+                "connstr (http://H1:P1,H2:P2) to fail over")
         if status != 200:
             raise IOError(f"docstore rpc {op!r}: HTTP {status}")
         reply = json.loads(raw)
@@ -557,6 +764,9 @@ class HttpDocStore(DocStore):
                         "TypeError": TypeError,
                         "PermissionError": PermissionError,
                         "DedupeEvictedError": DedupeEvictedError,
+                        # a primary that self-fenced between the HTTP
+                        # door and the write path answers in-body
+                        "NotPrimaryError": NotPrimaryError,
                         }.get(reply.get("type"), IOError)
             raise exc_type(reply.get("error", "rpc failed"))
         return reply["result"]

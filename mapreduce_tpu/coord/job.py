@@ -87,9 +87,9 @@ def ambient_scope(connection: Connection, storage_dsl) -> set:
     from ..utils.httpclient import split_embedded_token
 
     hosts = set()
-    hp = connection.board_hostport()
-    if hp:
-        hosts.add(hp)
+    # every replica of a multi-endpoint (HA) board is this job's own
+    # board: a claim that failed over mid-job still carries its auth
+    hosts.update(connection.board_hostports())
     # parse the DSL prefix directly: get_storage_from would mkdtemp as a
     # side effect for a bare "shared" string
     if isinstance(storage_dsl, str) and storage_dsl.startswith("http:"):

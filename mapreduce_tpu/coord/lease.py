@@ -29,6 +29,7 @@ only through atomic guarded updates:
 
 from __future__ import annotations
 
+import os
 import socket
 import time
 import uuid
@@ -207,3 +208,33 @@ class TrainerLease:
         """The current lease document (observability; statusz reads it)."""
         return self._cnn.connect().find_one(
             self.ns, {"_id": self.SINGLETON_ID})
+
+
+#: default board-primary lease (seconds) — the failover detection
+#: window: a SIGKILLed primary's standby takes over within one of
+#: these.  Must be comfortably under the board clients' retry deadline
+#: (httpclient.BOARD_DEADLINE, 12s) so a mutation in flight at the kill
+#: survives the takeover inside its own budget.
+DEFAULT_BOARD_LEASE = 2.0
+
+
+class BoardLease(TrainerLease):
+    """The board-primary election: the same guarded singleton
+    (seed-iff-absent, free-or-expired claim, ``$inc`` generation
+    fencing token) pointed at the HA directory's own little
+    :class:`~.docstore.DirDocStore` — the one store that must NOT live
+    on the board it elects.  The generation is stamped into every
+    mutation-log entry the holder appends, so a deposed primary's
+    straggling appends are identifiable (and skipped) on replay
+    (coord/ha.py)."""
+
+    SINGLETON_ID = "board"
+    COLL = "board_lease"
+
+    def __init__(self, cnn, holder: Optional[str] = None,
+                 lease: float = DEFAULT_BOARD_LEASE) -> None:
+        super().__init__(
+            cnn,
+            holder=holder or (f"board-{socket.gethostname()}-"
+                              f"{os.getpid()}-{uuid.uuid4().hex[:6]}"),
+            lease=lease)

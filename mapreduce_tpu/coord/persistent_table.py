@@ -1,4 +1,5 @@
-"""Distributed singleton key/value document with optimistic concurrency.
+"""Durable shared state: the persistent-table singleton AND the board
+mutation log.
 
 Parity with mapreduce/persistent_table.lua: a named singleton doc usable as
 shared runtime config across processes — ``set``/``update`` with a
@@ -11,15 +12,143 @@ Differences from the reference (intentional): attribute-style access is via
 ``[]``/``get`` rather than metatable magic; the dirty/commit split is
 explicit (``set`` stages locally, ``update`` syncs) exactly like the
 reference's semantics.
+
+:class:`MutationLog` is the durability layer UNDER the board itself
+(coord/ha.py): where the reference delegates control-plane durability to
+mongod's disk, the rebuild's docserver appends every board mutation to
+one shared append-only JSONL file that a standby replica tails and a
+restarted process replays — the write-ahead log the HA story and the
+durable single-node board both ride.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from .connection import Connection
 from . import docstore
+
+
+class BoardLogCorruptError(RuntimeError):
+    """A COMPLETE line of the board mutation log failed to parse: the
+    log is damaged and a replica must refuse to serve from it rather
+    than silently skip a mutation and diverge.  (A torn FINAL line
+    without its newline is NOT corruption — it is an append the writer
+    died inside, whose client never got a response; the reader simply
+    stops before it.)"""
+
+
+class MutationLog:
+    """Append-only JSONL mutation log on a shared directory.
+
+    * ``append(entry)`` — one ``os.write`` of one ``\\n``-terminated
+      line on an ``O_APPEND`` fd: atomic interleaving between the
+      primary and a (fenced, racing) stale writer, immediately visible
+      to tailing readers, and durable across SIGKILL of the writer (the
+      bytes are the kernel's once write() returns).  ``fsync=True``
+      additionally survives host/power death at a per-append cost.
+    * ``read_from(offset)`` — parse complete lines from *offset*; the
+      tail primitive.  Returns ``(entries, new_offset)``; a trailing
+      partial line is left for the next poll.
+
+    Entry ordering IS application ordering: the appender must hold its
+    store mutation and the append in one critical section
+    (coord/ha.py's ReplicatedDocStore does), so a replay reproduces the
+    primary's document state exactly.
+    """
+
+    def __init__(self, path: str, fsync: bool = False) -> None:
+        self.path = path
+        self.fsync = bool(fsync)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
+                           0o644)
+        self._lock = threading.Lock()
+        self.appended = 0
+
+    def append(self, entry: Dict[str, Any]) -> None:
+        self.append_many([entry])
+
+    def append_many(self, entries: List[Dict[str, Any]]) -> None:
+        """Append *entries* as ONE ``os.write`` — the atomic unit the
+        HA dedupe contract rides: a request's mutation entries and its
+        recorded response either all reach the log or none do."""
+        if not entries:
+            return
+        data = b"".join(
+            (json.dumps(e, separators=(",", ":"), sort_keys=True)
+             + "\n").encode()
+            for e in entries)
+        with self._lock:
+            # finish a short write (ENOSPC-with-some-room, NFS): a
+            # permanently torn line would read as a garbled COMPLETE
+            # line once the next append lands, bricking every replica.
+            # An os.write that RAISES propagates — the primary answers
+            # an error and nothing was acknowledged.
+            view = memoryview(data)
+            while view:
+                n = os.write(self._fd, view)
+                view = view[n:]
+            if self.fsync:
+                os.fsync(self._fd)
+            self.appended += len(entries)
+
+    def size(self) -> int:
+        try:
+            return os.stat(self.path).st_size
+        except FileNotFoundError:
+            return 0
+
+    def read_from(self, offset: int,
+                  ) -> Tuple[List[Dict[str, Any]], int]:
+        """Complete entries from byte *offset* on; ``new_offset`` is
+        the position just past the last complete line.  A garbled
+        COMPLETE line raises :class:`BoardLogCorruptError`."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(offset)
+                data = f.read()
+        except FileNotFoundError:
+            return [], offset
+        if not data:
+            return [], offset
+        end = data.rfind(b"\n")
+        if end < 0:
+            return [], offset  # only a torn tail so far
+        out: List[Dict[str, Any]] = []
+        pos = offset
+        for line in data[:end + 1].splitlines():
+            pos += len(line) + 1
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+                if not isinstance(doc, dict):
+                    raise ValueError("entry is not an object")
+            except (json.JSONDecodeError, UnicodeDecodeError,
+                    ValueError) as exc:
+                raise BoardLogCorruptError(
+                    f"board log {self.path}: complete line at "
+                    f"~byte {pos} unparseable ({exc})") from exc
+            out.append(doc)
+        return out, offset + end + 1
+
+    def replay(self, offset: int = 0) -> Iterator[Dict[str, Any]]:
+        entries, _ = self.read_from(offset)
+        return iter(entries)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+                self._fd = None  # type: ignore[assignment]
 
 
 class PersistentTable:

@@ -13,5 +13,8 @@ from .device_engine import DeviceEngine, EngineConfig, DeviceResult  # noqa: F40
 from .wordcount import (  # noqa: F401
     DeviceWordCount, materialize_counts, wordcount_map_fn)
 from .session import (  # noqa: F401
-    EngineSession, SessionOverflowError, SessionStreamBroken)
+    EngineSession, SessionBusyError, SessionOverflowError,
+    SessionStreamBroken)
+from .spill import (  # noqa: F401
+    SessionRestoreError, SessionSpillStore, SpillPolicy)
 from .topk import TopKWords, topk_bytes  # noqa: F401

@@ -48,6 +48,10 @@ from ..utils.jax_compat import quiet_unusable_donation
 from .device_engine import (
     AXIS, DeviceEngine, DeviceResult, EngineConfig, _DISPATCHES, _WAVES,
     _steady_cfg)
+from .spill import (
+    _RESIDENT, _RESTORES, _SPILL_SECONDS, _SPILLS, LANES,
+    SessionRestoreError, SessionSpillStore, SpillPolicy,
+    repartition_rows)
 
 _FEEDS = _obs.counter(
     "mrtpu_session_feeds_total",
@@ -80,6 +84,12 @@ _OVERFLOWS = _obs.counter(
     "mrtpu_session_overflow_rows_total",
     "rows a session stream dropped for capacity (labels: task); any "
     "nonzero value means that stream's aggregate is truncated")
+_BACKPRESSURE = _obs.counter(
+    "mrtpu_session_backpressure_total",
+    "feeds refused because the stream's bounded pending-feed queue "
+    "was full (labels: task, reason=feed_queue) — the loud-rejection "
+    "half of the serving latency contract: a session never queues "
+    "unboundedly behind a slow mesh")
 _STREAM_AGE = _obs.gauge(
     "mrtpu_session_stream_age_seconds",
     "seconds since a resident stream's last feed / last snapshot "
@@ -146,8 +156,19 @@ class SessionStreamBroken(RuntimeError):
     donated buffers may have been invalidated by the failed dispatch),
     so the aggregate is neither the pre-feed nor the post-feed state —
     retrying the feed would double-count the folded waves.  The stream
-    is POISONED: every feed/snapshot raises this until ``close(task)``
-    discards it and a fresh stream restarts from its source."""
+    is POISONED: every feed/snapshot raises this until either
+    ``close(task)`` discards it and a fresh stream restarts from its
+    source, or — when the session has a spill store and the stream was
+    spilled — ``restore(task)`` rolls it back to its last durable
+    checkpoint (re-feed from the checkpoint's ``pos``; nothing the
+    checkpoint already folded is folded twice)."""
+
+
+class SessionBusyError(RuntimeError):
+    """A feed was refused because *task*'s bounded pending-feed queue
+    was full (``max_pending_feeds``): the mesh is not keeping up with
+    this stream's arrival rate.  Backpressure by contract — the caller
+    sheds or slows; the session never queues unboundedly."""
 
 
 class _Stream:
@@ -184,7 +205,10 @@ class EngineSession:
     def __init__(self, mesh, map_fn: Callable,
                  config: EngineConfig = EngineConfig(),
                  k: Optional[int] = None,
-                 task: str = "-") -> None:
+                 task: str = "-",
+                 spill: Optional[SessionSpillStore] = None,
+                 spill_policy: Optional[SpillPolicy] = None,
+                 max_pending_feeds: int = 0) -> None:
         #: the engine's own task label stays the session default; per-
         #: feed labels ride the session counters
         self.engine = DeviceEngine(mesh, map_fn, config, task=task)
@@ -195,6 +219,16 @@ class EngineSession:
         self._row_dtype = None
         self._streams: Dict[str, _Stream] = {}
         self._lock = threading.Lock()
+        #: spill/restore plane (engine/spill.py): evicted streams
+        #: checkpoint here and restore lazily on their next feed
+        self.spill = spill
+        self.spill_policy = spill_policy
+        #: bounded per-task pending-feed queue: 0 = unbounded (the
+        #: pre-backpressure behavior), N = at most N feeds may WAIT on
+        #: the session lock per task — the N+1th is refused loudly
+        self.max_pending_feeds = int(max_pending_feeds)
+        self._pending: Dict[str, int] = {}
+        self._pending_lock = threading.Lock()
         #: ONE wave dispatcher for the session's lifetime (tiered
         #: configs): the session has one program shape, so the tier
         #: decision and the hot swap happen once per PROGRAM — a swap
@@ -241,11 +275,21 @@ class EngineSession:
     def _stream(self, task: str) -> _Stream:
         st = self._streams.get(task)
         if st is None:
-            acc = self.engine._acc_init(_steady_cfg(self.config),
-                                        self._row_shape,
-                                        self._row_dtype)
-            st = self._streams[task] = _Stream(acc)
+            # lazy restore: an evicted (or host-crashed) stream with a
+            # spilled checkpoint comes back transparently on its next
+            # touch — on THIS mesh, whatever mesh it was saved under
+            if self.spill is not None and self.spill.has(task):
+                st = self._restore_locked(task)
+            else:
+                acc = self.engine._acc_init(_steady_cfg(self.config),
+                                            self._row_shape,
+                                            self._row_dtype)
+                st = self._streams[task] = _Stream(acc)
+            self._refresh_resident()
         return st
+
+    def _refresh_resident(self) -> None:
+        _RESIDENT.set(len(self._streams), task="-")
 
     def _wave_fn(self):
         """The session's wave callable: the compiled program, or (for
@@ -263,23 +307,68 @@ class EngineSession:
         identical to the batch engine's per-wave program, with THIS
         task's accumulator threaded through as the donated carry.
         Returns the rows this feed overflowed (0 = exact)."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         if on_overflow not in ("raise", "count"):
             raise ValueError("on_overflow must be 'raise' or 'count', "
                              f"got {on_overflow!r}")
         task = self.default_task if task is None else str(task)
         chunks = np.ascontiguousarray(chunks)
         t0 = time.monotonic()
+        # bounded pending-feed queue: at most max_pending_feeds calls
+        # may WAIT on the session lock per task — the next one is
+        # refused loudly instead of queueing unboundedly behind a mesh
+        # that is not keeping up (ROADMAP item 3's backpressure half).
+        # The count covers WAITERS only: a feed moves out of it the
+        # moment it acquires the lock and starts executing, so N admits
+        # N genuinely queued feeds behind the executing one.
+        slot = [False]  # True while this feed holds a waiter slot
+        if self.max_pending_feeds > 0:
+            with self._pending_lock:
+                if self._pending.get(task, 0) >= self.max_pending_feeds:
+                    _BACKPRESSURE.inc(task=task, reason="feed_queue")
+                    raise SessionBusyError(
+                        f"stream {task!r}: {self.max_pending_feeds} "
+                        "feeds already pending — the mesh is behind "
+                        "this stream's arrival rate; shed or slow")
+                self._pending[task] = self._pending.get(task, 0) + 1
+                slot[0] = True
+        try:
+            return self._feed_locked(chunks, task, on_overflow, t0,
+                                     slot)
+        finally:
+            if slot[0]:  # died before acquiring the session lock
+                self._pending_done(task)
+
+    def _pending_done(self, task: str) -> None:
+        with self._pending_lock:
+            n = self._pending.get(task, 1) - 1
+            if n > 0:
+                self._pending[task] = n
+            else:
+                self._pending.pop(task, None)
+
+    def _feed_locked(self, chunks: np.ndarray, task: str,
+                     on_overflow: str, t0: float,
+                     slot: Optional[list] = None) -> int:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         with self._lock:
+            # this feed now EXECUTES: free its waiter slot so the bound
+            # admits N genuinely QUEUED feeds behind the running one
+            if slot is not None and slot[0]:
+                self._pending_done(task)
+                slot[0] = False
             self._latch(chunks)
             eng = self.engine
             st = self._stream(task)
             if st.broken:
+                restorable = (self.spill is not None
+                              and self.spill.has(task))
                 raise SessionStreamBroken(
                     f"stream {task!r} broke in an earlier feed; "
-                    "close(task) and restart it from the source")
+                    + ("restore(task) rolls it back to its last "
+                       "spilled checkpoint" if restorable else
+                       "close(task) and restart it from the source"))
             S = chunks.shape[0]
             rpw = self.k * eng.n_dev
             W = -(-S // rpw)
@@ -355,6 +444,10 @@ class EngineSession:
             _SESSION_SECONDS.inc(feed_s, stage="feed", task=task)
             _slo.observe_session_op("feed", task, feed_s)
         refresh_stream_age_gauges()
+        # density housekeeping OUTSIDE the lock: an idle / pressure
+        # eviction triggered by this feed must not extend its latency
+        # critical section
+        self.enforce_spill_policy()
         if feed_oflow and on_overflow == "raise":
             raise SessionOverflowError(
                 f"session stream {task!r} overflowed {feed_oflow} rows "
@@ -373,13 +466,24 @@ class EngineSession:
         t0 = time.monotonic()
         with self._lock:
             st = self._streams.get(task)
+            if (st is None and self.spill is not None
+                    and self.spill.has(task)):
+                # an evicted stream is still SERVABLE: restore lazily
+                # and answer from the checkpointed aggregate
+                st = self._restore_locked(task)
+                self._refresh_resident()
             if st is None:
                 raise KeyError(f"no stream {task!r} in this session "
                                f"(known: {sorted(self._streams)})")
             if st.broken:
+                restorable = (self.spill is not None
+                              and self.spill.has(task))
                 raise SessionStreamBroken(
                     f"stream {task!r} broke in an earlier feed; its "
-                    "aggregate is unusable — close(task) and restart")
+                    "aggregate is unusable — "
+                    + ("restore(task) rolls it back to its last "
+                       "spilled checkpoint" if restorable else
+                       "close(task) and restart"))
             eng = self.engine
             keys, vals, pay, valid = st.acc[:4]
             n_live = eng._host(valid.sum(axis=1))
@@ -415,13 +519,211 @@ class EngineSession:
             return {"chunks": st.pos, "waves": st.waves,
                     "feeds": st.feeds, "overflow": st.overflow}
 
-    def close(self, task: Optional[str] = None) -> None:
+    # -- spill / evict / restore (engine/spill.py) -------------------------
+
+    def _spill_meta(self, st: _Stream) -> Dict[str, object]:
+        from .device_engine import _cfg_token
+
+        return {
+            "pos": st.pos, "waves": st.waves, "feeds": st.feeds,
+            "overflow": st.overflow,
+            "k": self.k, "n_dev": self.engine.n_dev,
+            "row_shape": list(self._row_shape or ()),
+            "row_dtype": str(np.dtype(self._row_dtype))
+            if self._row_dtype is not None else None,
+            "config": _cfg_token(_steady_cfg(self.config)),
+        }
+
+    def _spill_locked(self, task: str, reason: str) -> int:
+        if self.spill is None:
+            raise RuntimeError(
+                "this session has no spill store: construct with "
+                "spill=SessionSpillStore(...)")
+        st = self._streams.get(task)
+        if st is None:
+            raise KeyError(f"no resident stream {task!r}")
+        if st.broken:
+            raise SessionStreamBroken(
+                f"stream {task!r} is poisoned; its accumulator must "
+                "not be spilled (restore() rolls back to the last "
+                "good spill instead)")
+        t0 = time.monotonic()
+        step = self.spill.save_stream(task, st.acc,
+                                      self._spill_meta(st))
+        _SPILLS.inc(task=task, reason=reason)
+        _SPILL_SECONDS.inc(time.monotonic() - t0, stage="spill",
+                           task=task)
+        return step
+
+    def spill_stream(self, task: Optional[str] = None,
+                     reason: str = "explicit") -> int:
+        """Checkpoint *task*'s resident accumulator to the spill store
+        (stream stays resident and live); returns the committed step.
+        Serialized with feeds/snapshots, so the spill observes exactly
+        the completed feeds — nothing mid-wave."""
+        task = self.default_task if task is None else str(task)
+        with self._lock:
+            return self._spill_locked(task, reason)
+
+    def evict(self, task: Optional[str] = None,
+              reason: str = "explicit") -> int:
+        """Spill *task* then drop its resident accumulator — the HBM
+        frees with the references; the next feed/snapshot restores it
+        lazily (possibly on a different mesh)."""
+        task = self.default_task if task is None else str(task)
+        with self._lock:
+            step = self._spill_locked(task, reason)
+            self._streams.pop(task, None)
+            self._refresh_resident()
+        refresh_stream_age_gauges()
+        return step
+
+    def _restore_locked(self, task: str) -> _Stream:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .device_engine import _cfg_token
+
+        t0 = time.monotonic()
+        lanes, meta = self.spill.load_stream(task)
+        want = _cfg_token(_steady_cfg(self.config))
+        got = meta.get("config")
+        if got != want:
+            raise SessionRestoreError(
+                f"stream {task!r} was spilled under engine config "
+                f"{got!r}; this session runs {want!r} — restoring "
+                "across configs would silently change the aggregate")
+        row_shape = tuple(meta.get("row_shape") or ())
+        row_dtype = np.dtype(meta["row_dtype"]) \
+            if meta.get("row_dtype") else None
+        if self._row_shape is None:
+            # restoring into a FRESH session: adopt the stream's
+            # latched shape (and wave split) so the program compiles
+            # to the same geometry
+            self._row_shape, self._row_dtype = row_shape, row_dtype
+            if self.k is None and meta.get("k"):
+                self.k = int(meta["k"])
+        elif (row_shape != self._row_shape
+                or row_dtype != np.dtype(self._row_dtype)):
+            raise SessionRestoreError(
+                f"stream {task!r} was spilled with row shape "
+                f"{row_shape}/{row_dtype}, session latched "
+                f"{self._row_shape}/{np.dtype(self._row_dtype)}")
+        n_dev_old = int(meta.get("n_dev") or self.engine.n_dev)
+        cfg = _steady_cfg(self.config)
+        resharded = n_dev_old != self.engine.n_dev
+        if resharded:
+            lanes = repartition_rows(
+                lanes, self.engine.n_dev, cfg.out_capacity, task=task)
+        sh = NamedSharding(self.engine.mesh, P(AXIS))
+        acc = []
+        for i, name in enumerate(LANES):
+            if name == "traffic":
+                if not cfg.exchange_stats:
+                    break
+                if resharded or name not in lanes:
+                    # historical routing cannot be re-binned onto a
+                    # different device count: the matrix restarts
+                    arr = np.zeros(
+                        (self.engine.n_dev, self.engine.n_dev),
+                        np.int32)
+                else:
+                    arr = lanes[name]
+            else:
+                arr = lanes[name]
+            acc.append(jax.device_put(arr, sh))
+        st = _Stream(acc)
+        st.pos = int(meta.get("pos") or 0)
+        st.waves = int(meta.get("waves") or 0)
+        st.feeds = int(meta.get("feeds") or 0)
+        st.overflow = int(meta.get("overflow") or 0)
+        # staleness restarts here: the newest record the stream
+        # reflects is only as old as this restore can prove
+        st.last_feed_monotonic = time.monotonic()
+        self._streams[task] = st
+        _RESTORES.inc(task=task,
+                      outcome="resharded" if resharded else "ok")
+        _SPILL_SECONDS.inc(time.monotonic() - t0, stage="restore",
+                           task=task)
+        return st
+
+    def restore(self, task: Optional[str] = None) -> _Stream:
+        """Explicitly restore *task* from its newest complete spill —
+        including OVER a poisoned stream: the broken resident state is
+        discarded and the stream rolls back to its last durable
+        checkpoint (re-feed from ``stats(task)['chunks']``; nothing the
+        checkpoint folded is ever folded twice)."""
+        if self.spill is None:
+            raise RuntimeError(
+                "this session has no spill store: construct with "
+                "spill=SessionSpillStore(...)")
+        task = self.default_task if task is None else str(task)
+        with self._lock:
+            # load FIRST: _restore_locked only replaces the resident
+            # stream once the spill is fully validated and placed — a
+            # failed restore (every candidate corrupt) must not also
+            # destroy a healthy resident accumulator
+            st = self._restore_locked(task)
+            self._refresh_resident()
+        refresh_stream_age_gauges()
+        return st
+
+    def enforce_spill_policy(self) -> List[str]:
+        """Apply the session's :class:`~.spill.SpillPolicy` (idle age,
+        resident cap, HBM pressure): evict the victims, return their
+        task names.  Called automatically at each feed epilogue; safe
+        to call from a housekeeping thread."""
+        policy = self.spill_policy
+        if policy is None or self.spill is None:
+            return []
+        now = time.monotonic()
+        with self._lock:
+            ages = {}
+            for task, st in self._streams.items():
+                if st.broken:
+                    continue  # poison is restore()'s problem, not idle
+                last = max(st.last_feed_monotonic or 0.0,
+                           st.last_snapshot_monotonic or 0.0)
+                ages[task] = now - last
+        pressed = policy.hbm_pressed(self.engine.mesh.devices.flat)
+        victims = policy.victims(ages, pressed)
+        evicted = []
+        for task in victims:
+            if (policy.max_idle_s is not None
+                    and ages.get(task, 0.0) > policy.max_idle_s):
+                reason = "idle"
+            elif pressed:
+                reason = "pressure"
+            else:
+                reason = "resident_cap"
+            try:
+                self.evict(task, reason=reason)
+            except (KeyError, SessionStreamBroken):
+                continue  # raced a close()/break; nothing to evict
+            evicted.append(task)
+        return evicted
+
+    def close(self, task: Optional[str] = None,
+              drop_spill: bool = True) -> None:
         """Drop one stream's (or every stream's) resident accumulator —
-        its HBM frees with the references."""
+        its HBM frees with the references.
+
+        Closing a NAMED task means "this stream is over": its spilled
+        history is dropped with it, or a later feed under the same
+        task name would silently resurrect the old checkpoint and
+        double-fold — exactly the outcome the spill plane promises
+        never to produce (``drop_spill=False`` keeps it for a
+        hand-off).  Closing the whole session (no task) is host
+        SHUTDOWN, not stream death: spilled history is left intact —
+        it is precisely the durable state the next host restores
+        from (``evict`` is the free-HBM-keep-durable path)."""
         with self._lock:
             if task is not None:
                 self._streams.pop(str(task), None)
             else:
                 self._streams.clear()
+            self._refresh_resident()
+        if self.spill is not None and drop_spill and task is not None:
+            self.spill.drop(str(task))
         # a closed stream's age series must not linger as a lie
         refresh_stream_age_gauges()

@@ -1,0 +1,264 @@
+"""Session spill/restore: resident accumulators made durable.
+
+Every resident :class:`~.session.EngineSession` stream pins a donated
+accumulator in HBM forever — PR 10's density ceiling, and (until now)
+its durability hole: kill the engine host and every stream's aggregate
+died with it.  This module checkpoints a stream's accumulator through
+the PR 7 blob/manifest machinery (per-shard digest-verified blobs,
+manifest-LAST atomic commit, fall-back-past-corrupt restore, keep-N
+retention) so a stream can be **evicted** — spilled to the blob plane
+and dropped from HBM — and **restored lazily** on its next feed,
+possibly on a DIFFERENT mesh:
+
+* **Same mesh**: the saved ``[n_dev, C, ...]`` lanes are ``device_put``
+  back with the session's sharding — bit-identical, byte for byte.
+* **Different device count**: a record's partition is ``key_hi % P``
+  (parallel/shuffle.py), which is computable on the host from the
+  saved key lanes — :func:`repartition_rows` re-bins every valid row
+  under the new partition count and re-sorts each partition by key,
+  reproducing exactly the accumulator an uninterrupted run on the new
+  mesh would hold (the traffic-matrix lane is historical routing and
+  restarts at zero on a mesh change).
+
+The spill metadata carries the stream's counters (``pos`` keeps
+payload byte offsets stream-global across the gap) and the engine
+config fingerprint — a restore into a mismatched config fails with
+names, never with silently different aggregates.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models import checkpoint as _ckpt
+from ..obs import metrics as _metrics
+
+#: lane names, in the accumulator's positional order (traffic only
+#: when EngineConfig.exchange_stats)
+LANES = ("keys", "vals", "pay", "valid", "traffic")
+
+_SPILLS = _metrics.counter(
+    "mrtpu_session_spills_total",
+    "session streams checkpointed to the blob plane (labels: task, "
+    "reason=explicit|idle|pressure|resident_cap)")
+_RESTORES = _metrics.counter(
+    "mrtpu_session_restores_total",
+    "session stream restores from spilled checkpoints (labels: task, "
+    "outcome=ok|resharded — resharded restores re-binned the rows "
+    "onto a different device count)")
+_SPILL_SECONDS = _metrics.counter(
+    "mrtpu_session_spill_seconds_total",
+    "wall seconds in session spill/restore (labels: stage=spill|"
+    "restore, task)")
+_RESIDENT = _metrics.gauge(
+    "mrtpu_session_resident_streams",
+    "streams currently holding a resident (HBM) accumulator in a live "
+    "session (labels: task=- whole-session count); spill payload "
+    "bytes ride the shared mrtpu_ckpt_bytes_total counter")
+
+
+class SessionRestoreError(RuntimeError):
+    """A spilled stream cannot be restored into THIS session: config /
+    row-shape mismatch, or a partition of the target mesh would
+    overflow ``out_capacity``.  Loud by contract — a silently
+    different aggregate is the one outcome the session layer never
+    produces."""
+
+
+class SessionSpillStore:
+    """Per-task checkpoint streams on one storage prefix.
+
+    Layout: ``<prefix><quoted task>/ckpt-XXXXXXXX/...`` — one PR 7
+    :class:`~..models.checkpoint.CheckpointManager` retention stream
+    per task, step = the stream's feed count at spill time."""
+
+    def __init__(self, storage, prefix: str = "sessions/",
+                 keep_n: int = 2) -> None:
+        self.storage = storage
+        self.prefix = prefix
+        self.keep_n = max(1, int(keep_n))
+
+    def _task_prefix(self, task: str) -> str:
+        return (self.prefix
+                + urllib.parse.quote(str(task), safe="") + "/")
+
+    def manager(self, task: str) -> "_ckpt.CheckpointManager":
+        return _ckpt.CheckpointManager(self.storage,
+                                       prefix=self._task_prefix(task),
+                                       keep_n=self.keep_n)
+
+    def has(self, task: str) -> bool:
+        return bool(_ckpt.list_steps(self.storage,
+                                     self._task_prefix(task)))
+
+    def tasks(self) -> List[str]:
+        """Every task with spilled history under this prefix."""
+        import re
+
+        rx = re.compile(f"^{re.escape(self.prefix)}([^/]+)/")
+        seen = set()
+        for name in self.storage.list(rx.pattern):
+            m = rx.match(name)
+            if m:
+                seen.add(urllib.parse.unquote(m.group(1)))
+        return sorted(seen)
+
+    def drop(self, task: str) -> None:
+        """Forget a task's spilled history (close-with-prejudice)."""
+        import re
+
+        rx = f"^{re.escape(self._task_prefix(task))}"
+        names = self.storage.list(rx)
+        if names:
+            self.storage.remove_many(names)
+
+    # -- save side ------------------------------------------------------
+
+    def save_stream(self, task: str, acc: List[Any],
+                    meta: Dict[str, Any]) -> int:
+        """Checkpoint one stream's accumulator lanes; returns the
+        committed step.  Shards first, MANIFEST.json last — a kill
+        mid-spill leaves the previous spill authoritative."""
+        from jax.sharding import PartitionSpec as P
+
+        from .device_engine import AXIS
+
+        tree = {name: arr for name, arr in zip(LANES, acc)}
+        step = int(meta.get("feeds", 0))
+        self.manager(task).save(
+            step, tree, rules=[(r".*", P(AXIS))], meta=dict(meta))
+        return step
+
+    # -- restore side ---------------------------------------------------
+
+    def load_stream(self, task: str,
+                    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        """Newest COMPLETE spill as host lanes + meta, falling back
+        past corrupt candidates (counted through the shared ckpt
+        metrics).  Raises :class:`SessionRestoreError` when no
+        complete spill survives."""
+        prefix = self._task_prefix(task)
+        steps = _ckpt.list_steps(self.storage, prefix)
+        skipped = 0
+        for step in reversed(steps):
+            try:
+                manifest = _ckpt.load_manifest(self.storage, prefix,
+                                               step)
+                lanes = {
+                    name: _ckpt.assemble_leaf(self.storage, name, entry)
+                    for name, entry in manifest["leaves"].items()}
+            except _ckpt.CheckpointCorruptError:
+                _ckpt.note_restore("corrupt")
+                skipped += 1
+                continue
+            _ckpt.note_restore("ok", step=step, fell_past=skipped)
+            return lanes, dict(manifest.get("meta") or {})
+        raise SessionRestoreError(
+            f"stream {task!r}: no complete spilled checkpoint under "
+            f"{prefix!r} ({len(steps)} candidates, all corrupt)"
+            if steps else
+            f"stream {task!r}: nothing spilled under {prefix!r}")
+
+
+def repartition_rows(lanes: Dict[str, np.ndarray], n_dev_new: int,
+                     out_capacity: int, task: str = "-",
+                     ) -> Dict[str, np.ndarray]:
+    """Re-bin a saved ``[n_dev_old, C, ...]`` accumulator onto
+    *n_dev_new* partitions: destination is ``key_hi % P`` (the
+    exchange's own partition function), rows within a partition sorted
+    by ``(key_hi, key_lo)`` — exactly the layout an uninterrupted run
+    on the new mesh maintains.  A partition that would overflow
+    *out_capacity* raises (loud, never truncated)."""
+    keys, vals, pay, valid = (lanes["keys"], lanes["vals"],
+                              lanes["pay"], lanes["valid"])
+
+    def flat(a: np.ndarray) -> np.ndarray:
+        return a.reshape((-1,) + a.shape[2:])
+
+    mask = flat(valid).astype(bool)
+    k = flat(keys)[mask]
+    v = flat(vals)[mask]
+    p = flat(pay)[mask]
+    dest = (k[:, 0].astype(np.uint64) % np.uint64(n_dev_new))
+    out = {
+        "keys": np.zeros((n_dev_new, out_capacity) + keys.shape[2:],
+                         keys.dtype),
+        "vals": np.zeros((n_dev_new, out_capacity) + vals.shape[2:],
+                         vals.dtype),
+        "pay": np.zeros((n_dev_new, out_capacity) + pay.shape[2:],
+                        pay.dtype),
+        "valid": np.zeros((n_dev_new, out_capacity), valid.dtype),
+    }
+    for d in range(n_dev_new):
+        rows = np.nonzero(dest == d)[0]
+        if rows.size > out_capacity:
+            raise SessionRestoreError(
+                f"stream {task!r}: partition {d} of the target mesh "
+                f"holds {rows.size} unique rows > out_capacity "
+                f"{out_capacity} — raise EngineConfig.out_capacity to "
+                "restore on this mesh")
+        order = np.lexsort((k[rows, 1], k[rows, 0]))
+        rows = rows[order]
+        out["keys"][d, :rows.size] = k[rows]
+        out["vals"][d, :rows.size] = v[rows]
+        out["pay"][d, :rows.size] = p[rows]
+        out["valid"][d, :rows.size] = True
+    return out
+
+
+class SpillPolicy:
+    """When to evict a resident stream (enforced at feed epilogues,
+    :meth:`~.session.EngineSession.enforce_spill_policy`):
+
+    * ``max_idle_s`` — a stream with no feed or snapshot for this long
+      spills (the thousands-of-mostly-idle-tenants density lever);
+    * ``max_resident`` — hard cap on resident streams per session;
+      beyond it the LEAST-recently-active spill first;
+    * ``hbm_frac`` — when any device's measured ``bytes_in_use``
+      crosses this fraction of ``bytes_limit`` (the PR 8 gauges),
+      evict the coldest stream.  Backends without memory_stats (CPU)
+      never trigger this clause — idle/cap still apply.
+    """
+
+    def __init__(self, max_idle_s: Optional[float] = None,
+                 max_resident: Optional[int] = None,
+                 hbm_frac: Optional[float] = None) -> None:
+        self.max_idle_s = max_idle_s
+        self.max_resident = max_resident
+        self.hbm_frac = hbm_frac
+
+    def hbm_pressed(self, devices) -> bool:
+        if self.hbm_frac is None:
+            return False
+        from ..obs.memory import sample_device_memory
+
+        sample = sample_device_memory(list(devices))
+        for entry in sample["devices"].values():
+            limit = entry.get("bytes_limit")
+            if limit and (entry.get("bytes_in_use", 0)
+                          >= self.hbm_frac * limit):
+                return True
+        return False
+
+    def victims(self, ages: Dict[str, float], hbm_pressed: bool,
+                ) -> List[str]:
+        """Tasks to evict given per-task idle ages (seconds),
+        coldest-first within each clause."""
+        coldest = sorted(ages, key=lambda t: -ages[t])
+        out: List[str] = []
+        if self.max_idle_s is not None:
+            out.extend(t for t in coldest
+                       if ages[t] > self.max_idle_s)
+        if (self.max_resident is not None
+                and len(ages) - len(out) > self.max_resident):
+            for t in coldest:
+                if len(ages) - len(out) <= self.max_resident:
+                    break
+                if t not in out:
+                    out.append(t)
+        if hbm_pressed and not out and coldest:
+            out.append(coldest[0])
+        return out

@@ -659,6 +659,50 @@ def _sched_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _durability_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Service-durability health from the cluster-aggregated HA/spill
+    families (coord/ha + engine/spill): board promotions/fences and
+    client failover rotations, session spill/restore traffic, and
+    feed-queue backpressure rejections."""
+    out: Dict[str, Any] = {}
+    failovers: Dict[str, int] = {}
+    backpressure: Dict[str, int] = {}
+    spills: Dict[str, int] = {}
+    restores: Dict[str, int] = {}
+    for name, labels, value in _metric_rows(doc):
+        if not value:
+            continue
+        if name == "mrtpu_board_promotions_total":
+            out["board_promotions"] = (out.get("board_promotions", 0)
+                                       + int(value))
+        elif name == "mrtpu_board_fences_total":
+            out["board_fences"] = out.get("board_fences", 0) + int(value)
+        elif name == "mrtpu_board_replayed_rid_refusals_total":
+            out["refused_rids"] = (out.get("refused_rids", 0)
+                                   + int(value))
+        elif name == "mrtpu_client_failovers_total":
+            ep = labels.get("endpoint", "-")
+            failovers[ep] = failovers.get(ep, 0) + int(value)
+        elif name == "mrtpu_session_backpressure_total":
+            t = labels.get("task", "-")
+            backpressure[t] = backpressure.get(t, 0) + int(value)
+        elif name == "mrtpu_session_spills_total":
+            t = labels.get("task", "-")
+            spills[t] = spills.get(t, 0) + int(value)
+        elif name == "mrtpu_session_restores_total":
+            t = labels.get("task", "-")
+            restores[t] = restores.get(t, 0) + int(value)
+    if failovers:
+        out["client_failovers"] = failovers
+    if backpressure:
+        out["session_backpressure"] = backpressure
+    if spills:
+        out["session_spills"] = spills
+    if restores:
+        out["session_restores"] = restores
+    return out
+
+
 # -- serving-SLO findings (obs/slo) ------------------------------------------
 
 
@@ -750,6 +794,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "comms": comms,
         "sched": _sched_findings(doc),
         "slo": _slo_findings(doc),
+        "durability": _durability_findings(doc),
         "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
         "trace_events": len(doc.get("traceEvents") or []),
@@ -855,6 +900,30 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
             f"session stream {task} dropped {rows} rows for capacity — "
             "its resident aggregate is truncated; raise EngineConfig "
             "capacities and restart the stream")
+    dur = report["durability"]
+    if dur.get("board_promotions"):
+        notes.append(
+            "board failover: {} standby promotion(s){} — the primary "
+            "died or was fenced; exactly-once held through the "
+            "replicated dedupe table{}".format(
+                dur["board_promotions"],
+                (", {} writer fence(s)".format(dur["board_fences"])
+                 if dur.get("board_fences") else ""),
+                (" ({} ambiguous in-flight rid(s) refused loudly)"
+                 .format(dur["refused_rids"])
+                 if dur.get("refused_rids") else "")))
+    if dur.get("client_failovers"):
+        total = sum(dur["client_failovers"].values())
+        notes.append(
+            f"clients rotated board endpoints {total} time(s) — "
+            "expected during a failover; sustained rotation means a "
+            "replica is flapping")
+    for task, n in sorted((dur.get("session_backpressure") or {})
+                          .items()):
+        notes.append(
+            f"session stream {task} refused {n} feed(s) at its "
+            "bounded pending queue — the mesh is behind this stream's "
+            "arrival rate (shed load or grow the mesh)")
     hot_compile = report["compile_hotspots"]
     if hot_compile and hot_compile[0]["total_s"] >= 5.0:
         h = hot_compile[0]

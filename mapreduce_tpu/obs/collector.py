@@ -182,6 +182,15 @@ DIAG_FAMILIES = frozenset({
     "mrtpu_slo_threshold_seconds",
     "mrtpu_sched_oldest_queued_age_seconds",
     "mrtpu_session_stream_age_seconds",
+    # the durability plane (coord/ha + engine/spill): board failovers,
+    # fences and client rotations, plus session spill/restore traffic
+    # and feed-queue backpressure — diagnose's service-durability
+    # notes read these cluster-wide
+    "mrtpu_board_promotions_total", "mrtpu_board_fences_total",
+    "mrtpu_board_replayed_rid_refusals_total",
+    "mrtpu_client_failovers_total",
+    "mrtpu_session_spills_total", "mrtpu_session_restores_total",
+    "mrtpu_session_backpressure_total",
 })
 
 #: diagnosis gauges that must merge across processes by MAX, not sum:
@@ -566,9 +575,13 @@ class TelemetryPusher:
         # lazy import: utils.httpclient imports obs.metrics at module
         # scope, so a top-level import here would cycle when the package
         # is first entered through httpclient
-        from ..utils.httpclient import KeepAliveClient, RetryPolicy
+        from ..utils.httpclient import FailoverClient, RetryPolicy
 
-        self._client = KeepAliveClient.from_address(
+        # FailoverClient: an HA board's standby answers /telemetry 421,
+        # so a pusher given the full replica list follows the primary
+        # across a failover — precisely when the durability counters it
+        # carries are worth reading (one address = plain client)
+        self._client = FailoverClient(
             address, what="telemetry collector", auth_token=auth_token,
             retry=RetryPolicy(max_attempts=2, base_delay=0.05,
                               max_delay=0.25, deadline=3.0,

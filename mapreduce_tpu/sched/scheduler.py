@@ -722,14 +722,31 @@ class SchedulerClient:
     """Client for the docserver's ``/tasks`` surface (the submit/list/
     cancel CLI rides it).  Mutations carry ``SESSION:SEQ`` request ids
     and are deduped server-side exactly like board RPCs — a retried
-    submit cannot enqueue twice."""
+    submit cannot enqueue twice.  Accepts the multi-endpoint HA board
+    form (``HOST:PORT,HOST:PORT``): a dead or standby replica rotates
+    under the one rid, and the replicated dedupe table keeps the
+    failed-over re-send exactly-once.
+
+    Backpressure contract: the server answers quota rejections with
+    HTTP 429 + the typed body.  This client strips 429 from its retry
+    statuses ON PURPOSE — an admission rejection is an ANSWER
+    (:class:`QuotaExceededError` with its reason), not a transient to
+    hammer through."""
 
     def __init__(self, address: str, auth_token: Optional[str] = None,
                  retry=None) -> None:
-        from ..utils.httpclient import KeepAliveClient
+        import dataclasses
 
-        self._client = KeepAliveClient.from_address(
-            address, what="scheduler", auth_token=auth_token, retry=retry)
+        from ..utils.httpclient import (
+            DEFAULT_RETRY_POLICY, FailoverClient)
+
+        policy = retry if retry is not None else DEFAULT_RETRY_POLICY
+        policy = dataclasses.replace(
+            policy,
+            retry_statuses=frozenset(policy.retry_statuses) - {429})
+        self._client = FailoverClient(
+            address, what="scheduler", auth_token=auth_token,
+            retry=policy)
         self._rid_session = uuid.uuid4().hex
         self._rid_seq = itertools.count(1)
         self._lock = threading.Lock()
@@ -749,7 +766,9 @@ class SchedulerClient:
         if status == 404:
             raise IOError(
                 "/tasks: this docserver predates the scheduler surface")
-        if status != 200:
+        if status not in (200, 429):
+            # 429 carries the typed quota rejection in its body — fall
+            # through to the typed-error dispatch below
             raise IOError(f"/tasks {op!r}: HTTP {status}")
         reply = json.loads(raw)
         if not reply.get("ok"):

@@ -90,11 +90,32 @@ _POOL_WAITS = _metrics.counter(
     "mrtpu_pool_waits_total",
     "requests that had to wait for a pooled connection because every "
     "slot was in flight (labels: endpoint)")
+_FAILOVERS = _metrics.counter(
+    "mrtpu_client_failovers_total",
+    "times a FailoverClient rotated away from an endpoint (labels: "
+    "endpoint=the one rotated AWAY from, reason=not_primary|transport)")
 
 
 class RetryError(IOError):
     """Every attempt failed (or the deadline budget ran out); the original
     transport error rides along as ``__cause__``."""
+
+
+class NotPrimaryError(IOError):
+    """The endpoint answered HTTP 421: it is a live board REPLICA that
+    does not currently hold the board-primary lease (coord/ha.py).  A
+    :class:`FailoverClient` rotates to the next endpoint on it; a
+    single-endpoint caller surfaces it (the board exists but is not
+    serving — usually a failover in progress)."""
+
+
+#: the HTTP status a standby/fenced board replica answers every request
+#: that needs the primary with.  421 Misdirected Request is exactly the
+#: semantic ("this server is not able to produce a response for this
+#: request") and — unlike 503 — is NOT in RETRYABLE_STATUSES, so a
+#: client never burns its whole retry budget against a healthy standby:
+#: the status comes back immediately and the failover layer rotates.
+NOT_PRIMARY_STATUS = 421
 
 
 class CircuitOpenError(ConnectionError):
@@ -476,6 +497,168 @@ class KeepAliveClient:
             if self._cnn is not None:
                 self._cnn.close()
                 self._cnn = None
+
+
+#: per-endpoint deadline a multi-endpoint FailoverClient probes each
+#: replica with before rotating: a SIGKILLed primary answers with an
+#: immediate refusal, a blackholed one must not eat the whole logical
+#: call's budget before the standby gets a turn.
+FAILOVER_PROBE_DEADLINE = 3.0
+
+
+class FailoverClient:
+    """One logical HTTP endpoint over N interchangeable replicas.
+
+    Built from a comma-separated address list
+    (``[TOKEN@]HOST:PORT[,HOST:PORT...]``) — the multi-endpoint
+    ``--board`` form.  With ONE address it delegates to a plain
+    :class:`KeepAliveClient` untouched (identical behavior to before
+    this class existed).  With several, each member gets a TIGHT
+    per-probe policy (one attempt, :data:`FAILOVER_PROBE_DEADLINE`) and
+    this wrapper runs the caller's RetryPolicy — attempts, backoff,
+    whole-call deadline — ACROSS the rotation: a transport failure or a
+    :data:`NOT_PRIMARY_STATUS` answer (a standby board replica) rotates
+    to the next endpoint and the call keeps its one budget.
+
+    Re-sending the identical bytes is what makes rotation safe: board
+    mutations carry their SESSION:SEQ rid across every endpoint, and
+    the HA board replicates the dedupe table through the mutation log
+    (coord/ha.py), so a retry answered by the NEW primary replays the
+    recorded response instead of re-applying.
+    """
+
+    def __init__(self, addresses, timeout: float = 60.0,
+                 what: str = "http endpoint",
+                 auth_token: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None) -> None:
+        if isinstance(addresses, str):
+            addresses = [a for a in addresses.split(",") if a]
+        if not addresses:
+            raise ValueError(f"{what} wants at least one HOST:PORT")
+        self.retry = retry if retry is not None else DEFAULT_RETRY_POLICY
+        if auth_token is None:
+            # a token embedded in ANY member address authenticates the
+            # whole replica set (they share one shared-secret)
+            for a in addresses:
+                embedded, _ = split_embedded_token(a)
+                if embedded:
+                    auth_token = embedded
+                    break
+        probe = self.retry
+        if len(addresses) > 1:
+            dl = (probe.deadline if probe.deadline is not None
+                  else BOARD_DEADLINE)
+            probe = dataclasses.replace(
+                probe, max_attempts=1,
+                deadline=min(dl, FAILOVER_PROBE_DEADLINE))
+        self._members = [
+            KeepAliveClient.from_address(a, timeout, what=what,
+                                         auth_token=auth_token,
+                                         retry=probe)
+            for a in addresses]
+        self._active = 0
+        self._rotate_lock = threading.Lock()
+
+    # -- introspection (error messages, ambient-auth scoping) ---------------
+
+    @property
+    def endpoints(self):
+        return [m.endpoint for m in self._members]
+
+    @property
+    def _current(self) -> KeepAliveClient:
+        return self._members[self._active]
+
+    @property
+    def host(self) -> str:
+        return self._current.host
+
+    @property
+    def port(self) -> int:
+        return self._current.port
+
+    @property
+    def endpoint(self) -> str:
+        return self._current.endpoint
+
+    @property
+    def auth_token(self):
+        return self._current.auth_token
+
+    def _rotate(self, frm: int, reason: str) -> None:
+        with self._rotate_lock:
+            if self._active != frm:
+                return  # lost the race: someone already rotated — one
+                # physical rotation must count once, not per caller
+            self._active = (self._active + 1) % len(self._members)
+        _FAILOVERS.inc(endpoint=self._members[frm].endpoint,
+                       reason=reason)
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None,
+                ) -> Tuple[int, bytes]:
+        status, _, data = self.request_full(method, path, body=body,
+                                            headers=headers)
+        return status, data
+
+    def request_full(self, method: str, path: str,
+                     body: Optional[bytes] = None,
+                     headers: Optional[Dict[str, str]] = None,
+                     ) -> Tuple[int, Dict[str, str], bytes]:
+        if len(self._members) == 1:
+            return self._members[0].request_full(method, path, body=body,
+                                                 headers=headers)
+        policy = self.retry
+        deadline = (policy.deadline if policy.deadline is not None
+                    else BOARD_DEADLINE)
+        give_up_at = time.monotonic() + deadline
+        last_exc: Optional[BaseException] = None
+        saw_not_primary = False
+        rotation = 0
+        while True:
+            idx = self._active
+            try:
+                status, resp_headers, data = \
+                    self._members[idx].request_full(method, path,
+                                                    body=body,
+                                                    headers=headers)
+            except (OSError, http.client.HTTPException) as exc:
+                # RetryError/CircuitOpenError are OSError subclasses:
+                # this endpoint is down or unreachable — rotate
+                last_exc = exc
+                self._rotate(idx, "transport")
+            else:
+                if status != NOT_PRIMARY_STATUS:
+                    return status, resp_headers, data
+                # a live standby: the primary is elsewhere (or a
+                # failover is mid-takeover) — rotate and re-send
+                saw_not_primary = True
+                self._rotate(idx, "not_primary")
+            rotation += 1
+            remaining = give_up_at - time.monotonic()
+            if remaining <= 0:
+                break
+            # back off once per full cycle through the replica set, so
+            # a takeover in progress (every endpoint answering 421) is
+            # polled, not hammered
+            if rotation % len(self._members) == 0:
+                pause = min(policy.backoff(
+                    rotation // len(self._members)), remaining)
+                if pause > 0:
+                    time.sleep(pause)
+        if saw_not_primary and last_exc is None:
+            raise NotPrimaryError(
+                f"{method} {path}: no board endpoint of "
+                f"{self.endpoints} held the primary lease within "
+                f"{deadline}s (failover still in progress?)")
+        raise RetryError(
+            f"{method} {path} failed against every board endpoint "
+            f"{self.endpoints} within {deadline}s") from last_exc
+
+    def close(self) -> None:
+        for m in self._members:
+            m.close()
 
 
 #: sockets a KeepAlivePool keeps per endpoint.  Sized for the blob

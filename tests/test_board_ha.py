@@ -1,0 +1,442 @@
+"""Board HA unit + integration suite (coord/ha.py): mutation-log
+semantics, deterministic replay, lease-fenced promotion/demotion,
+replicated dedupe across failover, multi-endpoint client rotation, and
+the 429 backpressure surface.  The SIGKILL-the-process acceptance
+scenario lives in tests/test_ha_chaos.py; here the "kill" is the
+in-process equivalent (HA loop stopped with the lease unreleased,
+validity horizon zeroed, listener closed) so every piece is assertable
+without subprocess plumbing."""
+
+import json
+import os
+import time
+
+import pytest
+
+from mapreduce_tpu.coord.docserver import (
+    DedupeEvictedError, DocServer, HttpDocStore)
+from mapreduce_tpu.coord.ha import HaController, ReplicatedDocStore
+from mapreduce_tpu.coord.docstore import MemoryDocStore
+from mapreduce_tpu.coord.persistent_table import (
+    BoardLogCorruptError, MutationLog)
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.sched.scheduler import QuotaExceededError, SchedulerClient
+from mapreduce_tpu.utils.httpclient import (
+    FailoverClient, KeepAliveClient, NotPrimaryError, RetryPolicy)
+
+FAST = RetryPolicy(max_attempts=4, base_delay=0.02, max_delay=0.2,
+                   deadline=10.0, breaker_threshold=0)
+
+
+def _kill(srv: DocServer) -> None:
+    """Make *srv* dead-to-clients without releasing its lease — the
+    silent-death (SIGKILL-shaped) path: the standby must wait out the
+    lease expiry."""
+    srv.ha._stop.set()
+    srv.ha._thread.join(timeout=10)
+    srv.ha._valid_until = 0.0
+    srv.httpd.shutdown()
+    srv.httpd.server_close()
+
+
+def _pair(tmp_path, lease=0.6):
+    d = str(tmp_path / "ha")
+    a = DocServer(ha_dir=d, ha_lease=lease).start_background()
+    b = DocServer(ha_dir=d, ha_lease=lease).start_background()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not (a.ha.is_primary()
+                                               or b.ha.is_primary()):
+        time.sleep(0.01)
+    assert a.ha.is_primary() or b.ha.is_primary()
+    prim, stby = (a, b) if a.ha.is_primary() else (b, a)
+    return a, b, prim, stby
+
+
+# -- MutationLog -------------------------------------------------------------
+
+
+def test_mutation_log_append_read_and_torn_tail(tmp_path):
+    log = MutationLog(str(tmp_path / "l" / "board.log"))
+    log.append({"op": "x", "n": 1})
+    log.append_many([{"op": "y"}, {"op": "z"}])
+    entries, off = log.read_from(0)
+    assert [e["op"] for e in entries] == ["x", "y", "z"]
+    # a torn final line (writer died mid-append) is NOT consumed and
+    # NOT corruption — the reader waits at the last complete line
+    with open(log.path, "ab") as f:
+        f.write(b'{"op": "torn"')
+    more, off2 = log.read_from(off)
+    assert more == [] and off2 == off
+    # ... but a COMPLETE garbled line is corruption, loudly
+    with open(log.path, "ab") as f:
+        f.write(b' garbage}\n')
+    with pytest.raises(BoardLogCorruptError):
+        log.read_from(off)
+    log.close()
+
+
+def test_replicated_store_replay_is_exact(tmp_path):
+    """A replay of the log reproduces the primary's documents exactly —
+    including store-generated insert ids and id-less upserts."""
+    log = MutationLog(str(tmp_path / "board.log"))
+    store = ReplicatedDocStore(MemoryDocStore(), log)
+    _id = store.insert("c.docs", {"v": 1})          # generated id
+    store.insert("c.docs", {"_id": "k", "v": 2})
+    store.update("c.docs", {"_id": "k"}, {"$inc": {"v": 5}})
+    store.update("c.docs", {"name": "up"}, {"$set": {"v": 9}},
+                 upsert=True)                        # id-less upsert
+    store.find_and_modify("c.docs", {"_id": "k"}, {"$set": {"fam": 1}})
+    store.find_and_modify_many("c.docs", {"v": {"$gte": 0}},
+                               {"$inc": {"seen": 1}}, limit=2)
+    store.remove("c.docs", {"_id": _id})
+    store.insert("c.other", {"_id": "o"})
+    store.drop_collection("c.other")
+
+    from mapreduce_tpu.coord.ha import apply_entry
+
+    replica = MemoryDocStore()
+    for e in log.replay():
+        apply_entry(replica, e)
+    for coll in ("c.docs", "c.other"):
+        assert sorted(replica.find(coll), key=lambda d: d["_id"]) \
+            == sorted(store.inner.find(coll), key=lambda d: d["_id"])
+    log.close()
+
+
+def test_stale_generation_entries_are_skipped(tmp_path):
+    """Replay discards a deposed primary's straggling appends: once a
+    higher generation has written, lower-generation entries are dead."""
+    log = MutationLog(str(tmp_path / "board.log"))
+    log.append({"op": "insert", "coll": "c.d", "g": 1, "s": 1,
+                "doc": {"_id": "a", "v": 1}})
+    log.append({"op": "insert", "coll": "c.d", "g": 2, "s": 1,
+                "doc": {"_id": "b", "v": 2}})
+    # the generation-1 holder's straggler, appended after its deposal
+    log.append({"op": "insert", "coll": "c.d", "g": 1, "s": 2,
+                "doc": {"_id": "stale", "v": 3}})
+    log.close()
+    ctl = HaController(str(tmp_path), lease=0.5)
+    ctl._apply_new()
+    ids = {d["_id"] for d in ctl.store.inner.find("c.d")}
+    assert ids == {"a", "b"}
+    ctl.log.close()
+
+
+# -- failover ---------------------------------------------------------------
+
+
+def test_corrupt_log_mid_tail_marks_replica_broken(tmp_path):
+    """A garbled COMPLETE log line hit while tailing flips the replica
+    to role 'broken' with .failed set — visible refusal to serve, not
+    a silently dead daemon thread that could still win the lease."""
+    a = HaController(str(tmp_path), lease=30.0).start()
+    assert a.wait_role("primary", timeout=10)
+    b = HaController(str(tmp_path), lease=30.0).start()
+    time.sleep(0.3)  # b is tailing as a replica
+    with open(a.log.path, "ab") as f:
+        f.write(b"{this is not json\n")
+    assert b.wait_role("broken", timeout=10)
+    assert b.failed is not None
+    assert "failed" in b.snapshot()
+    a.stop()
+    b.stop()
+
+
+def test_failover_client_and_replica_promotion(tmp_path):
+    """Writes fail over from a dead primary to the promoted standby
+    through one multi-endpoint handle, the standby's replica carries
+    every pre-kill mutation, and the dead replica's endpoint answered
+    with rotations, not burned retry budgets."""
+    a, b, prim, stby = _pair(tmp_path)
+    try:
+        cli = HttpDocStore(f"{a.host}:{a.port},{b.host}:{b.port}",
+                           retry=FAST)
+        cli.insert("t.docs", {"_id": "x", "v": 1})
+        cli.update("t.docs", {"_id": "x"}, {"$inc": {"v": 1}})
+        t0 = time.monotonic()
+        _kill(prim)
+        assert cli.update("t.docs", {"_id": "x"},
+                          {"$inc": {"v": 1}}) == 1
+        took = time.monotonic() - t0
+        assert stby.ha.is_primary()
+        assert cli.find_one("t.docs", {"_id": "x"})["v"] == 3
+        # takeover bounded by the lease (generous slack for a loaded box)
+        assert took < 0.6 * 4 + 2.0, took
+        # reads fail over too (the status/watch satellite's client path)
+        assert "t.docs" in cli.collections()
+        snap = cli.statusz()
+        assert snap["ha"]["role"] == "primary"
+        cli.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+def test_dedupe_replays_across_failover_exactly_once(tmp_path):
+    """A mutation the old primary answered, retried verbatim (same rid)
+    against the promoted standby, REPLAYS the recorded response instead
+    of re-applying — exactly-once across the failover by construction."""
+    a, b, prim, stby = _pair(tmp_path)
+    try:
+        cli = HttpDocStore(f"{prim.host}:{prim.port},"
+                           f"{stby.host}:{stby.port}", retry=FAST)
+        cli.insert("t.docs", {"_id": "x", "v": 1})
+        cli.update("t.docs", {"_id": "x"}, {"$inc": {"v": 1}})  # rid :2
+        _kill(prim)
+        stby.ha.wait_role("primary", timeout=10)
+        raw = json.dumps({"op": "update", "coll": "t.docs",
+                          "query": {"_id": "x"},
+                          "update": {"$inc": {"v": 1}},
+                          "rid": f"{cli._rid_session}:2"}).encode()
+        k = KeepAliveClient(stby.host, stby.port, retry=FAST)
+        status, body = k.request(
+            "POST", "/rpc", body=raw,
+            headers={"Content-Type": "application/json"})
+        assert status == 200 and json.loads(body)["ok"]
+        # NOT re-applied: the $inc already counted on the old primary
+        assert cli.find_one("t.docs", {"_id": "x"})["v"] == 2
+        k.close()
+        cli.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+def test_mutation_without_logged_response_is_refused(tmp_path):
+    """A rid whose mutations reached the log WITHOUT a recorded
+    response (the primary died inside the request) is refused loudly
+    on the successor — ambiguity surfaces, nothing re-applies."""
+    d = str(tmp_path / "ha")
+    log = MutationLog(os.path.join(d, "board.log"))
+    log.append({"op": "insert", "coll": "t.docs", "g": 1, "s": 1,
+                "doc": {"_id": "x", "v": 1}, "rid": "sess:7"})
+    log.close()
+    srv = DocServer(ha_dir=d, ha_lease=0.4).start_background()
+    try:
+        assert srv.ha.wait_role("primary", timeout=10)
+        # the mutation itself replayed
+        assert srv.ha.store.inner.find_one("t.docs",
+                                           {"_id": "x"})["v"] == 1
+        k = KeepAliveClient(srv.host, srv.port, retry=FAST)
+        raw = json.dumps({"op": "insert", "coll": "t.docs",
+                          "doc": {"_id": "x2"},
+                          "rid": "sess:7"}).encode()
+        status, body = k.request(
+            "POST", "/rpc", body=raw,
+            headers={"Content-Type": "application/json"})
+        reply = json.loads(body)
+        assert not reply["ok"] and reply["type"] == "DedupeEvictedError"
+        k.close()
+    finally:
+        srv.shutdown()
+
+
+def test_board_restart_replays_itself_durable(tmp_path):
+    """ONE replica over an HA dir is a durable board: restart it and
+    the documents — and the dedupe answers — come back from the log."""
+    d = str(tmp_path / "ha")
+    srv = DocServer(ha_dir=d, ha_lease=0.4).start_background()
+    assert srv.ha.wait_role("primary", timeout=10)
+    cli = HttpDocStore(f"{srv.host}:{srv.port}", retry=FAST)
+    cli.insert("t.docs", {"_id": "x", "v": 41})
+    cli.update("t.docs", {"_id": "x"}, {"$inc": {"v": 1}})
+    rid_session = cli._rid_session
+    cli.close()
+    srv.shutdown()
+
+    srv2 = DocServer(ha_dir=d, ha_lease=0.4).start_background()
+    try:
+        assert srv2.ha.wait_role("primary", timeout=10)
+        cli2 = HttpDocStore(f"{srv2.host}:{srv2.port}", retry=FAST)
+        assert cli2.find_one("t.docs", {"_id": "x"})["v"] == 42
+        # the PRE-restart $inc's rid replays from the restored dedupe
+        k = KeepAliveClient(srv2.host, srv2.port, retry=FAST)
+        raw = json.dumps({"op": "update", "coll": "t.docs",
+                          "query": {"_id": "x"},
+                          "update": {"$inc": {"v": 1}},
+                          "rid": f"{rid_session}:2"}).encode()
+        status, body = k.request(
+            "POST", "/rpc", body=raw,
+            headers={"Content-Type": "application/json"})
+        assert status == 200 and json.loads(body)["ok"]
+        assert cli2.find_one("t.docs", {"_id": "x"})["v"] == 42
+        k.close()
+        cli2.close()
+    finally:
+        srv2.shutdown()
+
+
+def test_standby_answers_421_and_single_endpoint_raises(tmp_path):
+    a, b, prim, stby = _pair(tmp_path)
+    try:
+        only_stby = HttpDocStore(f"{stby.host}:{stby.port}", retry=FAST)
+        with pytest.raises(NotPrimaryError):
+            only_stby.insert("t.docs", {"_id": "q"})
+        # GET observability stays served from the replica
+        snap = only_stby.statusz()
+        assert snap["ha"]["role"] == "replica"
+        only_stby.close()
+    finally:
+        for s in (a, b):
+            s.shutdown()
+
+
+def test_failover_client_single_endpoint_passthrough():
+    """One address = the pre-HA client, byte for byte: same policy
+    object, no rotation machinery in the path."""
+    fc = FailoverClient("127.0.0.1:1", retry=FAST)
+    assert fc.endpoints == ["127.0.0.1:1"]
+    assert fc._members[0].retry is FAST
+    fc.close()
+
+
+def test_failover_client_embedded_token_any_member():
+    fc = FailoverClient("127.0.0.1:1,tok@127.0.0.1:2")
+    assert all(m.auth_token == "tok" for m in fc._members)
+    fc.close()
+    # the THREE parsers of the multi-endpoint syntax agree: a token in
+    # any member must neither eat earlier members (ambient-auth scope)
+    # nor hide from Connection.auth_token
+    from mapreduce_tpu.coord.connection import Connection
+
+    cnn = Connection("http://h1:1,tok@h2:2", "db")
+    assert cnn.board_hostports() == ["h1:1", "h2:2"]
+    assert cnn.auth_token() == "tok"
+    assert cnn.board_hostport() == "h1:1,h2:2"
+
+
+def test_tasks_submit_transaction_survives_failover(tmp_path):
+    """A /tasks submit is a MULTI-mutation transaction (seq, task doc,
+    db reservation, tenant doc): its entries and recorded response
+    commit in one atomic log append, so the promoted standby carries
+    the whole submit and a verbatim rid re-send REPLAYS the original
+    answer instead of enqueueing a second task."""
+    a, b, prim, stby = _pair(tmp_path)
+    try:
+        cli = SchedulerClient(f"{a.host}:{a.port},{b.host}:{b.port}",
+                              retry=FAST)
+        doc = cli.submit("acme", est_jobs=1)
+        _kill(prim)
+        stby.ha.wait_role("primary", timeout=10)
+        lst = cli.list()
+        assert [t["_id"] for t in lst["tasks"]] == [doc["_id"]]
+        # verbatim re-send of the submit's rid against the successor
+        k = KeepAliveClient(stby.host, stby.port, retry=FAST)
+        raw = json.dumps({"op": "submit", "tenant": "acme",
+                          "est_jobs": 1,
+                          "rid": f"{cli._rid_session}:1"}).encode()
+        status, body = k.request(
+            "POST", "/tasks", body=raw,
+            headers={"Content-Type": "application/json"})
+        reply = json.loads(body)
+        assert status == 200 and reply["ok"]
+        assert reply["result"]["_id"] == doc["_id"]   # the REPLAY
+        assert len(cli.list()["tasks"]) == 1          # not a 2nd task
+        k.close()
+        cli.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+# -- backpressure over the wire (429) ---------------------------------------
+
+
+def test_scheduler_quota_rejection_is_429_typed_and_not_retried(tmp_path):
+    from mapreduce_tpu.sched.scheduler import SchedulerConfig
+
+    srv = DocServer(scheduler_config=SchedulerConfig(
+        tenant_max_queued_tasks=1)).start_background()
+    try:
+        cli = SchedulerClient(f"{srv.host}:{srv.port}", retry=FAST)
+        cli.submit("acme", est_jobs=1)
+        attempts0 = REGISTRY.sum("mrtpu_http_attempts_total",
+                                 endpoint=f"{srv.host}:{srv.port}")
+        with pytest.raises(QuotaExceededError) as ei:
+            cli.submit("acme", est_jobs=1)
+        assert ei.value.reason == "queued_tasks"
+        # ONE wire attempt: 429 was stripped from the retry statuses —
+        # backpressure rejects loudly instead of retry-storming
+        attempts = REGISTRY.sum("mrtpu_http_attempts_total",
+                                endpoint=f"{srv.host}:{srv.port}")
+        assert attempts - attempts0 == 1, attempts - attempts0
+        # the raw wire status IS 429 + the typed body
+        k = KeepAliveClient(srv.host, srv.port,
+                            retry=RetryPolicy(
+                                max_attempts=1, breaker_threshold=0,
+                                retry_statuses=frozenset()))
+        raw = json.dumps({"op": "submit", "tenant": "acme",
+                          "rid": "w:1"}).encode()
+        status, body = k.request(
+            "POST", "/tasks", body=raw,
+            headers={"Content-Type": "application/json"})
+        reply = json.loads(body)
+        assert status == 429 and reply["reason"] == "queued_tasks"
+        assert reply["type"] == "QuotaExceededError"
+        k.close()
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+# -- the watcher / runner-poll satellite ------------------------------------
+
+
+def test_status_watch_feed_survives_failover(tmp_path):
+    """The `status --watch` client path (HttpDocStore.statusz) keeps
+    answering across a primary kill — rotation, not a crash."""
+    a, b, prim, stby = _pair(tmp_path)
+    try:
+        cli = HttpDocStore(f"{a.host}:{a.port},{b.host}:{b.port}",
+                           retry=FAST)
+        assert cli.statusz()["ha"]["role"] in ("primary", "replica")
+        _kill(prim)
+        stby.ha.wait_role("primary", timeout=10)
+        snap = cli.statusz()
+        assert snap["ha"]["role"] == "primary"
+        assert snap["ha"]["promotions"] >= 1
+        cli.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+def test_task_runner_poll_survives_failover(tmp_path):
+    """The TaskRunner's scheduler polls ride the failover store: a tick
+    loop running through a primary kill keeps going and the scheduler
+    state survives on the successor (crash-safe by construction)."""
+    from mapreduce_tpu.sched.scheduler import Scheduler
+    from mapreduce_tpu.coord import docstore
+
+    a, b, prim, stby = _pair(tmp_path)
+    try:
+        store = docstore.connect(
+            f"http://{a.host}:{a.port},{b.host}:{b.port}", retry=FAST)
+        sch = Scheduler(store)
+        doc = sch.submit("t", db="ha_t1", est_jobs=1)
+        sch.tick()
+        _kill(prim)
+        stby.ha.wait_role("primary", timeout=10)
+        # the poll loop's ops after the kill succeed against the successor
+        states = {d["_id"]: d["state"] for d in sch.list_tasks()}
+        assert doc["_id"] in states
+        assert sch.tick() == []  # idempotent tick, post-failover
+        store.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.shutdown()
+            except Exception:
+                pass

@@ -1,0 +1,213 @@
+"""The kill-the-board-mid-stream acceptance scenario (ISSUE 13 /
+ROADMAP item 3): two REAL docserver OS processes over one shared HA
+dir; a wordcount task runs through the multi-endpoint connstr with a
+worker pinned INSIDE a job (the chaos_mods HOLD key) and a resident
+EngineSession feeding on the device plane while the primary is
+SIGKILLed.  Asserts:
+
+* the standby takes over within one lease period (plus bounded
+  detection/replay slack),
+* the exactly-once witness holds across the failover — every map job
+  STARTED exactly once and COMPLETED exactly once, no duplicate
+  applies from the replayed mutation log,
+* the session's post-failover snapshot is bit-identical to an
+  uninterrupted run over the same records (the device plane never
+  hiccups while the control plane fails over).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.server import Server
+from mapreduce_tpu.utils.httpclient import RetryPolicy
+from mapreduce_tpu.worker import spawn_worker_threads
+from tests import chaos_mods
+
+pytestmark = [pytest.mark.chaos]
+
+LEASE = 1.0
+CHAOS_RETRY = RetryPolicy(max_attempts=10, base_delay=0.02,
+                          max_delay=0.3, deadline=25.0,
+                          breaker_threshold=0)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _healthz(port: int, timeout: float = 0.5):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz",
+                timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
+
+
+def _spawn_docserver(port: int, ha_dir: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "mapreduce_tpu.cli", "docserver",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--ha-dir", ha_dir, "--ha-lease", str(LEASE)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait(pred, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.05)
+    raise TimeoutError(what)
+
+
+def test_sigkill_primary_mid_stream(tmp_path):
+    ha_dir = str(tmp_path / "ha")
+    p1, p2 = _free_port(), _free_port()
+    procs = [_spawn_docserver(p1, ha_dir), _spawn_docserver(p2, ha_dir)]
+    threads = []
+    feeder = {}
+    try:
+        for port in (p1, p2):
+            _wait(lambda port=port: _healthz(port) is not None, 30,
+                  f"docserver on {port} never served /healthz")
+        roles = _wait(
+            lambda: ({p: (_healthz(p) or {}).get("primary")
+                      for p in (p1, p2)}
+                     if any((_healthz(p) or {}).get("primary")
+                            for p in (p1, p2)) else None),
+            30, "no replica ever took the board lease")
+        prim_port = p1 if roles[p1] else p2
+        stby_port = p2 if prim_port == p1 else p1
+        prim = procs[0] if prim_port == p1 else procs[1]
+        connstr = f"http://127.0.0.1:{p1},127.0.0.1:{p2}"
+
+        # -- the host plane: a wordcount task with a pinned worker ------
+        files = []
+        for i in range(6):
+            f = tmp_path / f"part{i}.txt"
+            f.write_text(f"alpha beta part{i} gamma alpha\n" * 5)
+            files.append(str(f))
+        chaos_mods.reset(files, hold_key=2)
+        params = {r: "tests.chaos_mods"
+                  for r in ("taskfn", "mapfn", "partitionfn",
+                            "reducefn", "finalfn")}
+        params["storage"] = "mem:hakill"
+        threads = spawn_worker_threads(connstr, "hakill", 2,
+                                       retry=CHAOS_RETRY)
+        server = Server(connstr, "hakill", retry=CHAOS_RETRY)
+        server.configure(params)
+        import threading as _threading
+
+        stats_box = {}
+
+        def drive():
+            stats_box["stats"] = server.loop()
+
+        driver = _threading.Thread(target=drive, daemon=True)
+        driver.start()
+
+        # -- the device plane: a resident session feeding mid-kill ------
+        # (the shared synthetic record stream at test_session's
+        # config/shape: its wave program is warm from earlier suites,
+        # so this test pays failover wall, not a tokenizer compile)
+        from mapreduce_tpu.engine.device_engine import EngineConfig
+        from mapreduce_tpu.engine.session import EngineSession
+        from mapreduce_tpu.parallel import make_mesh
+        from tests.test_fused_engine import _chunks as _rec_chunks
+        from tests.test_fused_engine import _records_map_fn
+
+        cfg = EngineConfig(local_capacity=256, exchange_capacity=128,
+                           out_capacity=256, tile=64, tile_records=64,
+                           reduce_op="sum")
+        chunks = _rec_chunks(np.random.default_rng(13), 48)
+        mesh = make_mesh()
+        sess = EngineSession(mesh, _records_map_fn, cfg,
+                             task="live", k=1)
+        parts = np.array_split(np.arange(len(chunks)), 6)
+
+        def feed_loop():
+            for idx in parts:
+                sess.feed(chunks[idx[0]:idx[-1] + 1])
+                time.sleep(0.2)
+            feeder["done"] = True
+
+        feed_thread = _threading.Thread(target=feed_loop, daemon=True)
+
+        # wait until the held map job pins a worker mid-stream, then
+        # open fire: feeds running, worker traffic in flight, SIGKILL
+        _wait(lambda: chaos_mods.STARTED.get(2, 0) >= 1, 60,
+              "the held map job was never claimed")
+        feed_thread.start()
+        t_kill = time.monotonic()
+        os.kill(prim.pid, signal.SIGKILL)
+        prim.wait(timeout=10)
+
+        promoted = _wait(
+            lambda: ((_healthz(stby_port) or {}).get("primary")
+                     and time.monotonic()), 30,
+            "standby never took over after SIGKILL")
+        takeover_s = promoted - t_kill
+        # one lease period + bounded detection/replay slack (the
+        # standby claims as soon as the persisted expiry passes)
+        assert takeover_s <= LEASE + 2.0, (
+            f"standby takeover took {takeover_s:.2f}s "
+            f"(lease {LEASE}s)")
+
+        # release the pinned job only now: its heartbeat/claim traffic
+        # provably spanned the failover
+        chaos_mods.HOLD.set()
+        driver.join(timeout=120)
+        assert "stats" in stats_box, "server.loop did not finish"
+        _wait(lambda: feeder.get("done"), 120,
+              "session feed loop did not finish")
+
+        # exactly-once witness across the failover: every job STARTED
+        # exactly once and COMPLETED exactly once — the replayed board
+        # (claims, heartbeats, WRITTEN marks, dedupe) let nothing run
+        # twice and lost nothing
+        assert dict(chaos_mods.STARTED) == {i: 1 for i in range(6)}, \
+            dict(chaos_mods.STARTED)
+        assert dict(chaos_mods.COMPLETED) == {i: 1 for i in range(6)}, \
+            dict(chaos_mods.COMPLETED)
+        assert stats_box["stats"]["map"]["failed"] == 0
+        assert stats_box["stats"]["reduce"]["failed"] == 0
+        assert chaos_mods.RESULT["alpha"] == 6 * 5 * 2
+
+        # the device plane never hiccupped: post-failover snapshot is
+        # bit-identical to an uninterrupted run over the same records
+        got = sess.snapshot("live")
+        ref_sess = EngineSession(mesh, _records_map_fn, cfg,
+                                 task="ref", k=1)
+        for idx in parts:
+            ref_sess.feed(chunks[idx[0]:idx[-1] + 1])
+        ref = ref_sess.snapshot("ref")
+        for field in ("keys", "values", "payload", "valid"):
+            assert np.array_equal(np.asarray(getattr(got, field)),
+                                  np.asarray(getattr(ref, field))), field
+        sess.close()
+        ref_sess.close()
+    finally:
+        chaos_mods.HOLD.set()
+        for t in threads:
+            t.join(timeout=30)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

@@ -211,6 +211,13 @@ _MONOTONIC_ONLY_MODULES = {
     # a steppable clock would fabricate breaches (its only wall-clock
     # inputs are persisted board timestamps handed in by callers)
     os.path.join("mapreduce_tpu", "obs", "slo.py"),
+    # the durability plane: the HA controller's lease-validity horizon
+    # (is_primary's self-fence) and the spill/restore timings are pure
+    # monotonic arithmetic — a steppable clock in the self-fence would
+    # let a deposed primary keep writing (wall-clock lease timestamps
+    # are minted through coord/docstore.now inside coord/lease.py)
+    os.path.join("mapreduce_tpu", "coord", "ha.py"),
+    os.path.join("mapreduce_tpu", "engine", "spill.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions

@@ -1,0 +1,287 @@
+"""Session spill/restore equivalence suite (engine/spill.py): evict →
+lazy restore bit-identity on the same mesh, value-exact resharded
+restore onto a different device count, poisoned-stream rollback with
+no double-fold, config fencing, the bounded-feed-queue backpressure,
+and the idle/resident-cap eviction policy.
+
+Rides the shared synthetic record stream (tests/test_fused_engine's
+``_records_map_fn`` — keys, values AND a payload lane) at
+test_session's config/shape, so the same-mesh tests reuse the wave
+program test_session already compiled and the suite costs no
+tokenizer compile; the wordcount-flavoured spill path runs in
+tests/test_ha_chaos.py's acceptance scenario and bench.py's
+``session_restore_s`` measure."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.engine.device_engine import EngineConfig
+from mapreduce_tpu.engine.session import (
+    EngineSession, SessionBusyError, SessionStreamBroken)
+from mapreduce_tpu.engine.spill import (
+    SessionRestoreError, SessionSpillStore, SpillPolicy,
+    repartition_rows)
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.storage.memory import MemoryStorage
+from tests.test_fused_engine import _chunks as _rec_chunks
+from tests.test_fused_engine import _records_map_fn
+
+CFG = EngineConfig(local_capacity=256, exchange_capacity=128,
+                   out_capacity=256, tile=64, tile_records=64,
+                   reduce_op="sum")
+
+
+def _chunks(s=32, seed=7):
+    return _rec_chunks(np.random.default_rng(seed), s)
+
+
+def _snap_equal(a, b):
+    for f in ("keys", "values", "payload", "valid"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
+def _session(mesh, store=None, task="t", k=1, **kw):
+    return EngineSession(mesh, _records_map_fn, CFG, task=task, k=k,
+                         spill=store, **kw)
+
+
+def test_evict_restore_same_mesh_bit_identical():
+    """snapshot(after evict → lazy restore → rest of the stream) is
+    BIT-identical to an uninterrupted stream's — and the restore shows
+    in the metrics, not just the values."""
+    chunks = _chunks()
+    half = len(chunks) // 2
+    mesh = make_mesh()
+
+    s0 = _session(mesh, task="ref")
+    s0.feed(chunks[:half])
+    s0.feed(chunks[half:])
+    ref = s0.snapshot()
+
+    store = SessionSpillStore(MemoryStorage())
+    s1 = _session(mesh, store)
+    s1.feed(chunks[:half])
+    r0 = REGISTRY.sum("mrtpu_session_restores_total", task="t")
+    s1.evict()
+    assert s1.tasks() == []          # HBM reference dropped
+    s1.feed(chunks[half:])           # lazy restore on next feed
+    _snap_equal(s1.snapshot(), ref)
+    assert REGISTRY.sum("mrtpu_session_restores_total",
+                        task="t", outcome="ok") - r0 == 1
+    s0.close(), s1.close()
+
+
+def test_restore_into_fresh_session_serves_snapshot():
+    """A brand-new session (host restart) over the same spill store
+    answers a snapshot straight from the checkpointed aggregate —
+    row shape, wave split and counters all come back from the spill
+    metadata."""
+    chunks = _chunks()
+    mesh = make_mesh()
+    store = SessionSpillStore(MemoryStorage())
+    s1 = _session(mesh, store)
+    s1.feed(chunks)
+    ref = s1.snapshot()
+    stats = s1.stats()
+    s1.spill_stream()
+    # hand-off close: keep the durable history for the next host (a
+    # crashed host simply never closes — same restore path)
+    s1.close(drop_spill=False)
+
+    s2 = _session(mesh, store)
+    _snap_equal(s2.snapshot("t"), ref)
+    assert s2.stats("t") == stats    # pos/waves/feeds/overflow survive
+    s2.close()
+
+
+def test_close_drops_spill_no_resurrection():
+    """close(task) means "this stream is over": the spilled history
+    goes with it, so re-feeding the SAME source under the same task
+    name starts fresh instead of silently resuming the old checkpoint
+    and double-folding."""
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    store = SessionSpillStore(MemoryStorage())
+    s = _session(mesh, store)
+    s.feed(chunks)
+    s.spill_stream()
+    assert store.tasks() == ["t"]
+    s.close("t")                      # stream over: history dropped
+    assert not store.has("t") and store.tasks() == []
+    s.feed(chunks)                    # restart from the source
+    assert s.stats()["chunks"] == len(chunks)   # fresh, not resumed
+    ref = _session(mesh, task="ref")
+    ref.feed(chunks)
+    _snap_equal(s.snapshot(), ref.snapshot())
+    s.close(), ref.close()
+
+
+def test_resharded_restore_matches_uninterrupted_stream():
+    """Spill on 8 devices, restore + continue on 4: the stream's final
+    snapshot is bit-identical to an uninterrupted 4-device stream over
+    the same records (key_hi % P re-binning + per-partition key sort
+    reproduce the native layout)."""
+    chunks = _chunks()
+    half = len(chunks) // 2
+    store = SessionSpillStore(MemoryStorage())
+    m8, m4 = make_mesh(8), make_mesh(4)
+
+    sa = _session(m8, store)
+    sa.feed(chunks[:half])
+    sa.evict()
+    sa.close(drop_spill=False)
+
+    sb = _session(m4, store)
+    r0 = REGISTRY.sum("mrtpu_session_restores_total", task="t",
+                      outcome="resharded")
+    sb.feed(chunks[half:])
+    got = sb.snapshot()
+    assert REGISTRY.sum("mrtpu_session_restores_total", task="t",
+                        outcome="resharded") - r0 == 1
+
+    ref_s = _session(m4, task="ref4")
+    ref_s.feed(chunks[:half])
+    ref_s.feed(chunks[half:])
+    _snap_equal(got, ref_s.snapshot())
+    sb.close(), ref_s.close()
+
+
+def test_poisoned_stream_restores_with_no_double_fold():
+    """A feed dying mid-wave poisons the stream; restore(task) rolls it
+    back to the last spilled checkpoint and re-feeding EXACTLY the
+    records after the checkpoint's position yields an aggregate
+    bit-identical to an uninterrupted run — nothing double-folds."""
+    # k=1 on 8 devices: the 24-chunk second feed spans 3 waves, so the
+    # poison lands mid-feed with at least one wave already folded
+    chunks = _chunks(48)
+    half = len(chunks) // 2
+    mesh = make_mesh()
+    store = SessionSpillStore(MemoryStorage())
+
+    ref_s = _session(mesh, task="ref")
+    ref_s.feed(chunks[:half])
+    ref_s.feed(chunks[half:])
+    ref = ref_s.snapshot()
+
+    s = _session(mesh, store)
+    s.feed(chunks[:half])
+    s.spill_stream()                       # the durable rollback point
+    fed_to = s.stats()["chunks"]
+
+    class Boom(RuntimeError):
+        pass
+
+    real = s._wave_fn()
+
+    calls = {"n": 0}
+
+    def dying(*a, **k):
+        calls["n"] += 1
+        if calls["n"] >= 2:                # die on the feed's 2nd wave
+            raise Boom("mesh died mid-feed")
+        return real(*a, **k)
+
+    s._wave_fn = lambda: dying             # type: ignore[assignment]
+    with pytest.raises(Boom):
+        s.feed(chunks[half:])
+    s._wave_fn = lambda: real              # type: ignore[assignment]
+
+    # poisoned: feed AND snapshot refuse, naming the restore path
+    with pytest.raises(SessionStreamBroken, match="restore"):
+        s.feed(chunks[half:])
+    with pytest.raises(SessionStreamBroken, match="restore"):
+        s.snapshot()
+
+    st = s.restore()                       # roll back to the spill
+    assert st.pos == fed_to
+    s.feed(chunks[fed_to:])                # re-feed from the checkpoint
+    _snap_equal(s.snapshot(), ref)
+    ref_s.close(), s.close()
+
+
+def test_restore_refuses_mismatched_config():
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    store = SessionSpillStore(MemoryStorage())
+    s1 = _session(mesh, store)
+    s1.feed(chunks)
+    s1.evict()
+    s1.close(drop_spill=False)
+    import dataclasses
+
+    other = dataclasses.replace(CFG, out_capacity=512)
+    s2 = EngineSession(mesh, _records_map_fn, other, task="t",
+                       spill=store)
+    with pytest.raises(SessionRestoreError, match="config"):
+        s2.snapshot("t")
+    s2.close()
+
+
+def test_repartition_overflow_is_loud():
+    lanes = {
+        "keys": np.arange(16, dtype=np.uint32).reshape(2, 4, 2),
+        "vals": np.ones((2, 4), np.int32),
+        "pay": np.zeros((2, 4, 1), np.int32),
+        "valid": np.ones((2, 4), bool),
+    }
+    # force every row to one partition: key_hi % 1 == 0
+    with pytest.raises(SessionRestoreError, match="out_capacity"):
+        repartition_rows(lanes, 1, 4, task="t")
+
+
+def test_feed_backpressure_rejects_loudly():
+    """max_pending_feeds bounds the per-task feed queue: the N+1th
+    WAITER is refused with the typed error and counted, instead of
+    queueing unboundedly behind a busy mesh."""
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    s = _session(mesh, max_pending_feeds=1)
+    s.feed(chunks)  # latch shapes + compile outside the contended part
+    b0 = REGISTRY.sum("mrtpu_session_backpressure_total", task="t")
+    with s._lock:                      # the mesh is "busy"
+        t = threading.Thread(target=s.feed, args=(chunks,))
+        t.start()                      # waiter #1: admitted, pending=1
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not s._pending.get("t"):
+            time.sleep(0.005)
+        assert s._pending.get("t") == 1
+        with pytest.raises(SessionBusyError):
+            s.feed(chunks)             # waiter #2: refused loudly
+    t.join(timeout=30)
+    assert REGISTRY.sum("mrtpu_session_backpressure_total",
+                        task="t") - b0 == 1
+    s.close()
+
+
+def test_idle_and_resident_cap_eviction_policy():
+    """The SpillPolicy evicts idle streams at feed epilogues and holds
+    the resident-stream cap; evicted tenants restore lazily with their
+    aggregates intact."""
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    store = SessionSpillStore(MemoryStorage())
+    s = EngineSession(mesh, _records_map_fn, CFG, k=1, spill=store,
+                      spill_policy=SpillPolicy(max_resident=1))
+    s.feed(chunks, task="a")
+    ref_a = s.snapshot("a")
+    s.feed(chunks, task="b")           # cap=1: the colder "a" evicts
+    assert s.tasks() == ["b"]
+    assert REGISTRY.sum("mrtpu_session_spills_total", task="a",
+                        reason="resident_cap") >= 1
+    _snap_equal(s.snapshot("a"), ref_a)   # lazy restore, intact
+    s.close()
+
+    s2 = EngineSession(mesh, _records_map_fn, CFG, k=1, spill=store,
+                       spill_policy=SpillPolicy(max_idle_s=0.0))
+    s2.feed(chunks, task="x")
+    time.sleep(0.01)
+    s2.feed(chunks, task="y")          # x idle > 0.0s: evicted
+    assert "x" not in s2.tasks()
+    assert REGISTRY.sum("mrtpu_session_spills_total", task="x",
+                        reason="idle")
+    s2.close()
