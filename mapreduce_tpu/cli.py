@@ -214,6 +214,27 @@ def cmd_server(argv: List[str]) -> int:
                    help="seconds between telemetry pushes to the "
                         "docserver's collector (default 1.0; <= 0 "
                         "disables; http:// boards only)")
+    p.add_argument("--speculative-reclaim", dest="reclaim",
+                   action="store_true", default=True,
+                   help="straggler-driven speculative re-claim "
+                        "(engine/autotune): a RUNNING job held far "
+                        "beyond every other worker's completed-job "
+                        "profile is re-claimed before its lease "
+                        "expires; exactly-once rides the existing "
+                        "claim fencing, every re-claim lands in the "
+                        "control ledger (default ON for the CLI; "
+                        "library Servers default OFF)")
+    p.add_argument("--no-speculative-reclaim", dest="reclaim",
+                   action="store_false")
+    p.add_argument("--autotune", dest="autotune", action="store_true",
+                   default=True,
+                   help="capacity autotuning for the device fast path "
+                        "(engine/autotune): pre-size capacities from "
+                        "capacity-retry forensics + the shape registry "
+                        "(default ON for the CLI; library Servers "
+                        "default OFF)")
+    p.add_argument("--no-autotune", dest="autotune",
+                   action="store_false")
     _add_auth(p)
     _add_retry(p)
     _add_compile_cache(p)
@@ -242,8 +263,14 @@ def cmd_server(argv: List[str]) -> int:
         params["init_args"] = json.loads(args.init_args)
     if args.result_ns:
         params["result_ns"] = args.result_ns
+    from .engine.autotune import AutoTuner, SpeculativeReclaimer
+
     server = Server(args.connstr, args.dbname, auth=args.auth,
-                    retry=_retry_policy(args))
+                    retry=_retry_policy(args),
+                    reclaim=SpeculativeReclaimer() if args.reclaim
+                    else None)
+    if args.autotune:
+        server.autotune = AutoTuner(repartition=False)
     server.telemetry_interval = args.telemetry_interval
     server.configure(params)
     stats = server.loop()
@@ -332,6 +359,21 @@ def cmd_wordcount(argv: List[str]) -> int:
                         "default is the module's config (variadic)")
     p.add_argument("--workers", type=int, default=4)
     p.add_argument("--num-reducers", type=int, default=15)
+    p.add_argument("--autotune", dest="autotune", action="store_true",
+                   default=True,
+                   help="capacity autotuning (engine/autotune): the "
+                        "device engine pre-sizes capacities from "
+                        "capacity-retry forensics + the shape "
+                        "registry; decisions land in the control "
+                        "ledger (default ON for the CLI)")
+    p.add_argument("--no-autotune", dest="autotune",
+                   action="store_false")
+    p.add_argument("--speculative-reclaim", dest="reclaim",
+                   action="store_true", default=True,
+                   help="straggler-driven speculative re-claim of "
+                        "host-plane jobs (default ON for the CLI)")
+    p.add_argument("--no-speculative-reclaim", dest="reclaim",
+                   action="store_false")
     _add_compile_cache(p)
     _add_trace(p)
     _add_verbosity(p)
@@ -366,7 +408,13 @@ def cmd_wordcount(argv: List[str]) -> int:
         from .worker import spawn_worker_threads
 
         threads = spawn_worker_threads(connstr, "wc", args.workers)
-    server = Server(connstr, "wc")
+    from .engine.autotune import AutoTuner, SpeculativeReclaimer
+
+    server = Server(connstr, "wc",
+                    reclaim=SpeculativeReclaimer() if args.reclaim
+                    else None)
+    if args.autotune:
+        server.autotune = AutoTuner(repartition=False)
     server.configure(params)
     server.loop()
     wedged = []
@@ -894,6 +942,25 @@ def _render_slo(slo: dict) -> List[str]:
     return lines
 
 
+def _render_control(ctrl: dict) -> List[str]:
+    """The control section of /statusz (obs/control): the observe->act
+    loop's decisions — per-controller outcome counts plus the newest
+    decisions with their evidence->action->outcome story."""
+    if not ctrl or not ctrl.get("decisions"):
+        return []
+    lines = ["control plane (observe->act):"]
+    for c, by_o in sorted((ctrl.get("counts") or {}).items()):
+        lines.append("  {}: {}".format(c, "  ".join(
+            f"{o}={n}" for o, n in sorted(by_o.items()))))
+    for d in ctrl["decisions"][-8:]:  # newest tail; bundles keep all
+        lines.append(
+            "  [{}] #{} task {} ({}, {:.0f}s ago): {}".format(
+                d.get("controller"), d.get("id"), d.get("task"),
+                d.get("outcome"), d.get("age_s", 0.0),
+                d.get("note") or "decision"))
+    return lines
+
+
 def _render_build(build: dict) -> List[str]:
     if not build:
         return []
@@ -980,6 +1047,7 @@ def render_status(snap: dict) -> str:
     lines += _render_checkpoint(snap.get("checkpoint") or {})
     lines += _render_sched(snap.get("sched") or {})
     lines += _render_slo(snap.get("slo") or {})
+    lines += _render_control(snap.get("control") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
     tasks = snap.get("tasks", {})
     if not tasks:
@@ -1362,6 +1430,13 @@ def cmd_submit(argv: List[str]) -> int:
                    help="declared input bytes (quota accounting)")
     p.add_argument("--init-args", default=None,
                    help="JSON passed to every module init()")
+    p.add_argument("--program", default=None,
+                   help="compile-ledger program token this task's "
+                        "device phase dispatches (e.g. wave): "
+                        "telemetry-informed admission routes to a mesh "
+                        "whose ledger is warm for it; without it the "
+                        "task kind is the key, which matches no ledger "
+                        "token — warmth routing is then inert")
     _add_auth(p)
     _add_verbosity(p)
     args = p.parse_args(argv)
@@ -1377,6 +1452,8 @@ def cmd_submit(argv: List[str]) -> int:
     }
     if args.init_args:
         params["init_args"] = json.loads(args.init_args)
+    if args.program:
+        params["program"] = args.program
     client = _sched_client(args.connstr, args.auth, "submit")
     if client is None:
         return 2
@@ -1496,10 +1573,23 @@ def cmd_runner(argv: List[str]) -> int:
     from .sched.service import TaskRunner, spawn_scheduled_workers
     from .utils.httpclient import default_auth_token, split_embedded_token
 
+    from .engine.autotune import AdmissionAdvisor, local_mesh_facts
+
     retry = _retry_policy(args)
     store = docstore.connect(args.connstr, auth=args.auth, retry=retry)
+    # telemetry-informed admission (ON for the CLI surface): the
+    # runner process hosts the admitted tasks' device engines, so ITS
+    # compile-ledger warmth + HBM headroom are the placement facts —
+    # registered as mesh "local" now and refreshed while serving.
+    # With nothing registered the advisor is a strict no-op; warm
+    # picks (and any multi-mesh choice an embedder registers) land in
+    # the control ledger
+    advisor = AdmissionAdvisor()
+    warm, hbm = local_mesh_facts()
+    advisor.register_mesh("local", warm_programs=warm, hbm_frac=hbm)
     scheduler = Scheduler(
-        store, config=SchedulerConfig(max_inflight=args.max_inflight))
+        store, config=SchedulerConfig(max_inflight=args.max_inflight),
+        advisor=advisor)
     # normalized HOST:PORT (the one embedded-token parser): a TOKEN@
     # connstr must key the SAME shared pusher the pool's workers use,
     # never a second one under a token-bearing address string
@@ -1524,6 +1614,11 @@ def cmd_runner(argv: List[str]) -> int:
         # rejected by the board — must exit with the diagnosis, not
         # idle as a zombie advertising workers it no longer has
         while not runner._stop.wait(1.0):
+            # keep the advisor's placement facts live: warmth grows as
+            # tasks compile, HBM gauges move at every engine wave
+            warm, hbm = local_mesh_facts()
+            advisor.register_mesh("local", warm_programs=warm,
+                                  hbm_frac=hbm)
             if any(w.failed is not None for w in pool):
                 break
         failure = runner.failed or next(

@@ -158,6 +158,17 @@ class EngineConfig:
     #:     is invisible in results); warm buckets go straight to
     #:     tier-1 and nothing changes.
     sort_impl: str = "variadic"
+    #: skew-aware partition assignment (engine/autotune.py): route each
+    #: record through a replicated ``[B] int32`` bucket->partition
+    #: indirection table instead of the hard-wired ``key_hi % P``.  The
+    #: identity table reproduces ``key_hi % P`` bit-for-bit (``P | B``),
+    #: so turning this on changes nothing until a controller actually
+    #: rebalances; OFF by default — the table is one more program input,
+    #: and embedders who never rebalance should not carry it.
+    partition_map: bool = False
+    #: buckets in the indirection table (0 = auto: PARTITION_MAP_GRANULARITY
+    #: per device) — more buckets = finer-grained rebalancing
+    partition_buckets: int = 0
 
     def cache_key(self):
         # the op object itself is part of the key: keeping it in the
@@ -167,7 +178,8 @@ class EngineConfig:
                 self.out_capacity, self.tile, self.tile_records,
                 self.reduce_op, self.unit_values, self.combine_in_scan,
                 self.combine_capacity, self.rank_sort,
-                self.exchange_stats, self.sort_impl)
+                self.exchange_stats, self.sort_impl,
+                self.partition_map, self.partition_buckets)
 
     def scan_combine_slots(self, T: int) -> int:
         """Static buffer slots one chunk's pre-reduced records occupy
@@ -193,6 +205,47 @@ def _wave_donate_argnums(cfg: "EngineConfig"):
 
 
 _SORT_IMPLS = ("variadic", "argsort", "tiered")
+
+#: auto bucket count per device for the partition-map indirection
+#: table: enough granularity that a single hot partition's buckets can
+#: be spread across the whole mesh, small enough that the replicated
+#: table is noise (8·P int32s)
+PARTITION_MAP_GRANULARITY = 8
+
+
+def partition_buckets_for(cfg: EngineConfig, n_dev: int) -> int:
+    """The indirection table's bucket count B (a multiple of the
+    partition count, so the identity table reproduces ``key_hi % P``)."""
+    B = cfg.partition_buckets or PARTITION_MAP_GRANULARITY * n_dev
+    if B % n_dev:
+        raise ValueError(
+            f"partition_buckets {B} must be a multiple of the device "
+            f"count {n_dev} (the identity table's bit-identity to "
+            "key_hi % P depends on P | B)")
+    return B
+
+
+def identity_pmap(B: int, n_dev: int) -> np.ndarray:
+    """The identity bucket->partition table: ``pmap[b] = b % P`` —
+    bit-identical routing to the hard-wired ``key_hi % P``."""
+    return (np.arange(B, dtype=np.int64) % n_dev).astype(np.int32)
+
+
+def validate_partition_map(pmap, buckets: int,
+                           n_dev: int) -> np.ndarray:
+    """Normalize + validate a bucket->partition table (shared by the
+    engine's batch path and the session's mid-stream rebalance — ONE
+    spelling of the contract).  The table IS the partition function:
+    a malformed one routes records into nonexistent partitions, so
+    both failure modes raise loudly.  Returns the int32 host copy."""
+    pmap = np.asarray(pmap, dtype=np.int32).reshape(-1)
+    if pmap.shape[0] != buckets:
+        raise ValueError(f"partition map has {pmap.shape[0]} buckets, "
+                         f"config says {buckets}")
+    if pmap.size and (pmap.min() < 0 or pmap.max() >= n_dev):
+        raise ValueError(
+            f"partition map routes outside [0, {n_dev})")
+    return pmap
 
 
 def _tier_cfgs(cfg: EngineConfig):
@@ -387,7 +440,7 @@ class DeviceEngine:
 
     def __init__(self, mesh: Mesh, map_fn: Callable,
                  config: EngineConfig = EngineConfig(),
-                 task: str = "-") -> None:
+                 task: str = "-", autotune=None) -> None:
         if config.sort_impl not in _SORT_IMPLS:
             raise ValueError(
                 f"EngineConfig.sort_impl must be one of {_SORT_IMPLS}, "
@@ -396,6 +449,15 @@ class DeviceEngine:
         self.map_fn = map_fn
         self.config = config
         self.n_dev = mesh.shape[AXIS]
+        #: the observe->act loop (engine/autotune.AutoTuner): None (the
+        #: default) is the pre-control engine bit-for-bit — no decision
+        #: is ever recorded, no capacity is ever pre-sized
+        self.autotune = autotune
+        #: the batch path's bucket->partition table (partition_map
+        #: configs only); identity until set_partition_map installs a
+        #: rebalanced one.  Sessions carry a table PER STREAM instead.
+        self._pmap_host: np.ndarray = None
+        self._pmap_dev = None
         #: ONE background tier-1 compile thread per engine
         #: (engine/tiering.py), created on the first cold tiered
         #: dispatch
@@ -426,7 +488,13 @@ class DeviceEngine:
         def per_device(chunks: jax.Array, chunk_idx: jax.Array,
                        n_real: jax.Array, acc_k: jax.Array,
                        acc_v: jax.Array, acc_p: jax.Array,
-                       acc_valid: jax.Array, *acc_tr: jax.Array):
+                       acc_valid: jax.Array, *extra: jax.Array):
+            # trailing args, in order: the donated traffic-matrix
+            # accumulator row (exchange_stats) then the replicated
+            # bucket->partition table (partition_map) — an INPUT only,
+            # never donated, never an output lane
+            acc_tr = extra[:1] if cfg.exchange_stats else ()
+            pmap = extra[-1] if cfg.partition_map else None
             # chunks: [k, ...chunk_shape], chunk_idx: [k] global indices,
             # n_real: [] count of genuine chunks — indices >= n_real are
             # padding added to even out the mesh; their records (and any
@@ -538,7 +606,8 @@ class DeviceEngine:
                                     local.valid, AXIS,
                                     cfg.exchange_capacity,
                                     carry=(acc_k[0], acc_v[0], acc_p[0],
-                                           acc_valid[0]))
+                                           acc_valid[0]),
+                                    pmap=pmap)
 
             fin = sorted_unique_reduce(
                 ex.keys, ex.values, ex.payload, ex.valid, cfg.out_capacity,
@@ -578,10 +647,13 @@ class DeviceEngine:
 
         sharded = P(AXIS)
         n_extra = 1 if cfg.exchange_stats else 0
+        # the partition-map table is a replicated INPUT with no output
+        # twin — in_specs grows, out_specs does not
+        pmap_specs = (P(),) if cfg.partition_map else ()
         fn = shard_map(
             per_device, mesh=self.mesh,
             in_specs=(sharded, sharded, P(), sharded, sharded, sharded,
-                      sharded) + (sharded,) * n_extra,
+                      sharded) + (sharded,) * n_extra + pmap_specs,
             out_specs=(sharded,) * (6 + n_extra),
         )
         # donate the accumulator (its buffers alias the fin outputs —
@@ -608,6 +680,44 @@ class DeviceEngine:
         if key not in self._compiled:
             self._compiled[key] = self._program(cfg)
         return self._compiled[key]
+
+    # -- the partition map (skew-aware routing, engine/autotune) -----------
+
+    @property
+    def partition_buckets(self) -> int:
+        return partition_buckets_for(self.config, self.n_dev)
+
+    def partition_map(self) -> np.ndarray:
+        """The batch path's current bucket->partition table (host
+        copy); identity until :meth:`set_partition_map`."""
+        if self._pmap_host is None:
+            self._pmap_host = identity_pmap(self.partition_buckets,
+                                            self.n_dev)
+        return self._pmap_host
+
+    def set_partition_map(self, pmap: np.ndarray) -> None:
+        """Install a rebalanced bucket->partition table for future runs
+        (requires ``config.partition_map``).  Validated loudly: the
+        table is the partition function — a malformed one would route
+        records into nonexistent partitions."""
+        if not self.config.partition_map:
+            raise ValueError("set_partition_map needs "
+                             "EngineConfig.partition_map=True")
+        self._pmap_host = validate_partition_map(
+            pmap, self.partition_buckets, self.n_dev)
+        self._pmap_dev = None  # re-commit lazily with the run's mesh
+
+    def device_pmap(self, pmap_host: np.ndarray = None):
+        """A committed replicated device copy of *pmap_host* (default:
+        the engine's own table)."""
+        if pmap_host is not None:
+            return jax.device_put(
+                np.asarray(pmap_host, dtype=np.int32),
+                NamedSharding(self.mesh, P()))
+        if self._pmap_dev is None:
+            self._pmap_dev = jax.device_put(
+                self.partition_map(), NamedSharding(self.mesh, P()))
+        return self._pmap_dev
 
     def _tier_specializer(self):
         if self._tier_spec is None:
@@ -859,6 +969,19 @@ class DeviceEngine:
             self._compiled[key] = mem
         return self._compiled[key]
 
+    def autotune_key(self) -> str:
+        """The capacity controller's learning key: everything that
+        identifies the PROGRAM FAMILY minus the capacities themselves
+        (two runs of one workload at different capacities must share a
+        key, or nothing would ever be learned across a resize)."""
+        cfg = self.config
+        return "|".join([
+            _compile_obs.op_token(self.map_fn),
+            _compile_obs.op_token(cfg.reduce_op)
+            if callable(cfg.reduce_op) else str(cfg.reduce_op),
+            str(cfg.unit_values), str(cfg.combine_in_scan),
+            str(cfg.sort_impl), str(cfg.tile), str(self.n_dev)])
+
     def _replay_info(self, cfg: EngineConfig, structs):
         """The shape-bucket registry's replay record: enough to rebuild
         and AOT-prime this exact wave program in a fresh process
@@ -961,6 +1084,9 @@ class DeviceEngine:
         if cfg.exchange_stats:
             shapes += (jax.ShapeDtypeStruct(
                 (self.n_dev, self.n_dev), np.int32, sharding=row_sh),)
+        if cfg.partition_map:
+            shapes += (jax.ShapeDtypeStruct(
+                (self.partition_buckets,), np.int32, sharding=rep),)
         # a 'tiered' policy primes BOTH per-tier programs: a warmed
         # machine must never fall back to tier-0 serving (the warmness
         # probe sees the tier-1 bucket and skips tiering outright)
@@ -1056,6 +1182,13 @@ class DeviceEngine:
         import time
 
         cfg = self.config
+        # observe->act: a configured capacity controller pre-sizes this
+        # run's capacities from prior retry forensics / the shape
+        # registry (engine/autotune.py; every jump lands in the control
+        # ledger).  autotune=None — the default — changes NOTHING.
+        if self.autotune is not None:
+            cfg = self.autotune.recommend_config(
+                cfg, self.autotune_key(), task=self.task_label)
         t_start = time.monotonic()
         feeder = None
         pairs = None  # staged, pre-resolved waves (consumed in place)
@@ -1100,6 +1233,11 @@ class DeviceEngine:
         #: the tiered formulation exists to shrink (bench.py gates it
         #: as cold_first_dispatch_s)
         t_first_dispatch = None
+        # the replicated bucket->partition table rides every dispatch of
+        # a partition_map run (an input, so a rebalance between runs
+        # never recompiles); constant across attempts — capacities
+        # resize, the bucket count does not
+        pmap_args = ((self.device_pmap(),) if cfg.partition_map else ())
         try:
             depth = self._max_inflight_programs()
             for attempt in range(max_retries + 1):
@@ -1215,11 +1353,12 @@ class DeviceEngine:
                                 cost_shapes = tuple(
                                     jax.ShapeDtypeStruct(a.shape, a.dtype,
                                                          sharding=a.sharding)
-                                    for a in (ci, ii, n_real, *acc))
+                                    for a in (ci, ii, n_real, *acc,
+                                              *pmap_args))
                             # ONE dispatch per wave: map→sort→exchange→fold,
                             # the running uniques threaded through as
                             # donated args (out[:4] reuse their buffers)
-                            out = fn(ci, ii, n_real, *acc)
+                            out = fn(ci, ii, n_real, *acc, *pmap_args)
                             if t_first_dispatch is None:
                                 t_first_dispatch = time.monotonic()
                             _DISPATCHES.inc(1, program="wave",
@@ -1291,6 +1430,13 @@ class DeviceEngine:
                     devices=self._devices,
                     old_capacities=_capacities(cfg),
                     new_capacities=_capacities(new_cfg))
+                if self.autotune is not None:
+                    # the capacity controller learns the right-sized
+                    # capacities, so the NEXT run (or session) with this
+                    # program starts there instead of retrying again
+                    self.autotune.note_retry(
+                        self.autotune_key(), _capacities(cfg),
+                        _capacities(new_cfg), task=self.task_label)
                 cfg = new_cfg
                 del acc, keys, vals, pay, valid, traffic
                 # inputs were freed wave by wave: the retry re-uploads
@@ -1312,6 +1458,11 @@ class DeviceEngine:
                 feeder.close()
             if pairs:
                 pairs.clear()
+        if self.autotune is not None:
+            # the next control window's measurement: zero retries after
+            # a pre-sized start resolves the pending capacity decision
+            self.autotune.note_run(self.autotune_key(), retries,
+                                   task=self.task_label)
         if total_oflow and on_overflow == "raise":
             raise RuntimeError(
                 f"device run still overflowed {total_oflow} rows after "

@@ -177,7 +177,8 @@ class _Stream:
     wordcount's byte positions stay stream-global across feeds)."""
 
     __slots__ = ("acc", "pos", "waves", "feeds", "overflow", "broken",
-                 "last_feed_monotonic", "last_snapshot_monotonic")
+                 "last_feed_monotonic", "last_snapshot_monotonic",
+                 "pmap", "pmap_dev", "rebalances")
 
     def __init__(self, acc) -> None:
         self.acc = acc
@@ -186,6 +187,13 @@ class _Stream:
         self.feeds = 0
         self.overflow = 0
         self.broken = False
+        #: this stream's bucket->partition table (partition_map configs
+        #: only): PER STREAM, because a rebalance re-bins exactly one
+        #: tenant's resident accumulator — identity until the skew
+        #: controller (engine/autotune.py) installs a rebalanced one
+        self.pmap: Optional[np.ndarray] = None
+        self.pmap_dev = None
+        self.rebalances = 0
         #: monotonic instant the newest folded record arrived (set when
         #: its feed completes) — the snapshot-staleness reference point
         self.last_feed_monotonic: Optional[float] = None
@@ -208,10 +216,22 @@ class EngineSession:
                  task: str = "-",
                  spill: Optional[SessionSpillStore] = None,
                  spill_policy: Optional[SpillPolicy] = None,
-                 max_pending_feeds: int = 0) -> None:
+                 max_pending_feeds: int = 0,
+                 autotune=None) -> None:
         #: the engine's own task label stays the session default; per-
         #: feed labels ride the session counters
         self.engine = DeviceEngine(mesh, map_fn, config, task=task)
+        if autotune is not None:
+            # capacity pre-sizing at the session door: sessions cannot
+            # capacity-retry, so learned capacities must land BEFORE
+            # the wave program's shape is fixed (autotune_key ignores
+            # capacities, so the probe engine's key IS the tuned one's)
+            tuned = autotune.recommend_config(
+                config, self.engine.autotune_key(), task=task)
+            if tuned is not config:
+                config = tuned
+                self.engine = DeviceEngine(mesh, map_fn, config,
+                                           task=task)
         self.config = config
         self.k = int(k) if k else None
         self.default_task = task
@@ -223,6 +243,12 @@ class EngineSession:
         #: checkpoint here and restore lazily on their next feed
         self.spill = spill
         self.spill_policy = spill_policy
+        #: the observe->act loop (engine/autotune.AutoTuner): consulted
+        #: at each feed epilogue (outside the lock, like the spill
+        #: policy) — None, the default, is the pre-control session
+        #: bit-for-bit: no rebalance ever happens, no decision is ever
+        #: recorded
+        self.autotune = autotune
         #: bounded per-task pending-feed queue: 0 = unbounded (the
         #: pre-backpressure behavior), N = at most N feeds may WAIT on
         #: the session lock per task — the N+1th is refused loudly
@@ -299,6 +325,20 @@ class EngineSession:
         if self._dispatcher is None:
             self._dispatcher = self.engine._wave_fn(self.config)
         return self._dispatcher
+
+    def _pmap_args(self, st: _Stream) -> tuple:
+        """The stream's replicated bucket->partition table, as the wave
+        program's trailing input (empty without ``partition_map``)."""
+        if not self.config.partition_map:
+            return ()
+        if st.pmap is None:
+            from .device_engine import identity_pmap
+
+            st.pmap = identity_pmap(self.engine.partition_buckets,
+                                    self.engine.n_dev)
+        if st.pmap_dev is None:
+            st.pmap_dev = self.engine.device_pmap(st.pmap)
+        return (st.pmap_dev,)
 
     def feed(self, chunks: np.ndarray, task: Optional[str] = None,
              on_overflow: str = "raise") -> int:
@@ -386,6 +426,7 @@ class EngineSession:
             tiered = self.config.sort_impl == "tiered"
             feed_oflow = 0
             wave_tiers: Dict[str, int] = {}
+            pmap_args = self._pmap_args(st)
             try:
                 with quiet_unusable_donation():
                     for w in range(W):
@@ -400,7 +441,7 @@ class EngineSession:
                         ii = jax.device_put(
                             np.arange(st.pos + lo, st.pos + lo + rpw,
                                       dtype=np.int32), sharded)
-                        out = fn(ci, ii, n_real, *st.acc)
+                        out = fn(ci, ii, n_real, *st.acc, *pmap_args)
                         _DISPATCHES.inc(1, program="wave", task=task)
                         # per-wave serving tier ("-" untiered): a feed
                         # that spans the hot swap counts waves under
@@ -448,6 +489,17 @@ class EngineSession:
         # eviction triggered by this feed must not extend its latency
         # critical section
         self.enforce_spill_policy()
+        # the observe->act loop, also outside the lock: the skew
+        # controller reads this feed's traffic window and may rebalance
+        # the stream's partition map (its own lock acquisition; a
+        # decision — applied or refused — lands in the control ledger)
+        if self.autotune is not None:
+            if st.feeds == 1:
+                # sessions cannot retry, so a pre-sized stream's FIRST
+                # feed is the capacity decision's measurement window
+                self.autotune.note_session_feed(
+                    self.engine.autotune_key(), feed_oflow, task=task)
+            self.autotune.after_feed(self, task)
         if feed_oflow and on_overflow == "raise":
             raise SessionOverflowError(
                 f"session stream {task!r} overflowed {feed_oflow} rows "
@@ -516,15 +568,121 @@ class EngineSession:
             st = self._streams.get(task)
             if st is None:
                 return {}
-            return {"chunks": st.pos, "waves": st.waves,
-                    "feeds": st.feeds, "overflow": st.overflow}
+            out = {"chunks": st.pos, "waves": st.waves,
+                   "feeds": st.feeds, "overflow": st.overflow}
+            if self.config.partition_map:
+                # only partition_map streams can rebalance; embedders
+                # without the feature see exactly the pre-control keys
+                out["rebalances"] = st.rebalances
+            return out
+
+    # -- skew-aware repartition (engine/autotune.RepartitionController) ----
+
+    def traffic_matrix(self, task: Optional[str] = None,
+                       ) -> Optional[np.ndarray]:
+        """Host copy of *task*'s cumulative exchange traffic matrix
+        (the donated [P, P] lane; None without ``exchange_stats`` or an
+        unknown/broken stream) — the skew controller's evidence input."""
+        task = self.default_task if task is None else str(task)
+        with self._lock:
+            st = self._streams.get(task)
+            if (st is None or st.broken
+                    or not self.config.exchange_stats):
+                return None
+            return np.asarray(self.engine._host(st.acc[4]))
+
+    def bucket_histogram(self, task: Optional[str] = None,
+                         ) -> Optional[np.ndarray]:
+        """Resident unique rows per hash bucket (``key_hi % B``) of
+        *task*'s accumulator — the weights a rebalance bins onto
+        partitions.  Requires ``partition_map``."""
+        task = self.default_task if task is None else str(task)
+        if not self.config.partition_map:
+            return None
+        B = self.engine.partition_buckets
+        with self._lock:
+            st = self._streams.get(task)
+            if st is None or st.broken:
+                return None
+            keys, valid = self.engine._host(st.acc[0], st.acc[3])
+        k_hi = np.asarray(keys)[..., 0].reshape(-1).astype(np.uint64)
+        mask = np.asarray(valid).reshape(-1).astype(bool)
+        return np.bincount((k_hi[mask] % np.uint64(B)).astype(np.int64),
+                           minlength=B).astype(np.int64)
+
+    def partition_map(self, task: Optional[str] = None,
+                      ) -> Optional[np.ndarray]:
+        """*task*'s current bucket->partition table (host copy)."""
+        task = self.default_task if task is None else str(task)
+        if not self.config.partition_map:
+            return None
+        from .device_engine import identity_pmap
+
+        with self._lock:
+            st = self._streams.get(task)
+            if st is None:
+                return None
+            if st.pmap is None:
+                return identity_pmap(self.engine.partition_buckets,
+                                     self.engine.n_dev)
+            return np.array(st.pmap)
+
+    def rebalance(self, task: Optional[str], pmap: np.ndarray) -> None:
+        """Install a new bucket->partition table on *task*'s stream
+        MID-STREAM: the resident accumulator is re-binned on the host
+        under the new map (``repartition_rows`` with the pmap
+        indirection — the spill plane's reshard path) and placed back,
+        and every future wave routes through the new table.  The
+        result is bit-identical to a from-scratch run under the new
+        map (the golden suite pins this).  Raises
+        :class:`~.spill.SessionRestoreError` when any partition's
+        re-binned rows would overflow ``out_capacity`` — the stream is
+        left UNTOUCHED on refusal (re-bin first, install after), and
+        the caller (the skew controller) counts the refusal."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if not self.config.partition_map:
+            raise ValueError(
+                "rebalance needs EngineConfig.partition_map=True")
+        from .device_engine import validate_partition_map
+
+        task = self.default_task if task is None else str(task)
+        eng = self.engine
+        pmap = validate_partition_map(pmap, eng.partition_buckets,
+                                      eng.n_dev)
+        cfg = _steady_cfg(self.config)
+        with self._lock:
+            st = self._streams.get(task)
+            if st is None:
+                raise KeyError(f"no resident stream {task!r}")
+            if st.broken:
+                raise SessionStreamBroken(
+                    f"stream {task!r} is poisoned; rebalance refused")
+            lanes = {name: np.asarray(a) for name, a in
+                     zip(LANES, eng._host(*st.acc[:4]))}
+            # re-bin FIRST: an overflowing partition raises here and
+            # the resident stream (old map, old layout) is untouched
+            binned = repartition_rows(lanes, eng.n_dev,
+                                      cfg.out_capacity, task=task,
+                                      pmap=pmap)
+            sh = NamedSharding(eng.mesh, P(AXIS))
+            new_acc = [jax.device_put(binned[name], sh)
+                       for name in ("keys", "vals", "pay", "valid")]
+            # the traffic lane is historical routing under the OLD map;
+            # it stays cumulative (the controller reads deltas)
+            new_acc += list(st.acc[4:])
+            st.acc = new_acc
+            st.pmap = pmap
+            st.pmap_dev = None  # re-commit lazily at the next feed
+            st.rebalances += 1
 
     # -- spill / evict / restore (engine/spill.py) -------------------------
 
     def _spill_meta(self, st: _Stream) -> Dict[str, object]:
         from .device_engine import _cfg_token
 
-        return {
+        meta = {
             "pos": st.pos, "waves": st.waves, "feeds": st.feeds,
             "overflow": st.overflow,
             "k": self.k, "n_dev": self.engine.n_dev,
@@ -533,6 +691,13 @@ class EngineSession:
             if self._row_dtype is not None else None,
             "config": _cfg_token(_steady_cfg(self.config)),
         }
+        if st.pmap is not None:
+            # the stream's rebalanced routing table is part of its
+            # layout: a restore without it would route future waves
+            # differently from the rows already binned
+            meta["pmap"] = [int(v) for v in st.pmap]
+            meta["rebalances"] = st.rebalances
+        return meta
 
     def _spill_locked(self, task: str, reason: str) -> int:
         if self.spill is None:
@@ -612,7 +777,13 @@ class EngineSession:
         n_dev_old = int(meta.get("n_dev") or self.engine.n_dev)
         cfg = _steady_cfg(self.config)
         resharded = n_dev_old != self.engine.n_dev
+        saved_pmap = meta.get("pmap")
         if resharded:
+            # a rebalanced table is tied to its bucket count (a multiple
+            # of the OLD device count): cross-mesh restores re-bin under
+            # the new mesh's identity map and the skew controller starts
+            # over from fresh evidence
+            saved_pmap = None
             lanes = repartition_rows(
                 lanes, self.engine.n_dev, cfg.out_capacity, task=task)
         sh = NamedSharding(self.engine.mesh, P(AXIS))
@@ -637,6 +808,9 @@ class EngineSession:
         st.waves = int(meta.get("waves") or 0)
         st.feeds = int(meta.get("feeds") or 0)
         st.overflow = int(meta.get("overflow") or 0)
+        if saved_pmap is not None and self.config.partition_map:
+            st.pmap = np.asarray(saved_pmap, dtype=np.int32)
+            st.rebalances = int(meta.get("rebalances") or 0)
         # staleness restarts here: the newest record the stream
         # reflects is only as old as this restore can prove
         st.last_feed_monotonic = time.monotonic()

@@ -165,13 +165,19 @@ class SessionSpillStore:
 
 def repartition_rows(lanes: Dict[str, np.ndarray], n_dev_new: int,
                      out_capacity: int, task: str = "-",
+                     pmap: Optional[np.ndarray] = None,
                      ) -> Dict[str, np.ndarray]:
     """Re-bin a saved ``[n_dev_old, C, ...]`` accumulator onto
     *n_dev_new* partitions: destination is ``key_hi % P`` (the
-    exchange's own partition function), rows within a partition sorted
-    by ``(key_hi, key_lo)`` — exactly the layout an uninterrupted run
-    on the new mesh maintains.  A partition that would overflow
-    *out_capacity* raises (loud, never truncated)."""
+    exchange's own partition function) — or, with *pmap*, the
+    bucket->partition indirection ``pmap[key_hi % B]`` the skew
+    controller routes future waves through (engine/autotune.py rides
+    this to re-bin a RESIDENT accumulator mid-stream so a rebalanced
+    map and its history agree) — rows within a partition sorted by
+    ``(key_hi, key_lo)``, exactly the layout an uninterrupted run
+    under the same map maintains.  A partition that would overflow
+    *out_capacity* raises (loud, never truncated — the controller
+    counts the refusal instead of applying a lossy rebalance)."""
     keys, vals, pay, valid = (lanes["keys"], lanes["vals"],
                               lanes["pay"], lanes["valid"])
 
@@ -182,7 +188,13 @@ def repartition_rows(lanes: Dict[str, np.ndarray], n_dev_new: int,
     k = flat(keys)[mask]
     v = flat(vals)[mask]
     p = flat(pay)[mask]
-    dest = (k[:, 0].astype(np.uint64) % np.uint64(n_dev_new))
+    if pmap is not None:
+        pmap = np.asarray(pmap, dtype=np.int32).reshape(-1)
+        bucket = (k[:, 0].astype(np.uint64)
+                  % np.uint64(pmap.shape[0])).astype(np.int64)
+        dest = pmap[bucket].astype(np.uint64)
+    else:
+        dest = (k[:, 0].astype(np.uint64) % np.uint64(n_dev_new))
     out = {
         "keys": np.zeros((n_dev_new, out_capacity) + keys.shape[2:],
                          keys.dtype),

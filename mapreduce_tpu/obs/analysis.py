@@ -396,6 +396,108 @@ def _memory_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# -- the control plane: decisions, cross-referenced into findings ------------
+
+
+#: human surfaces (diagnose notes + rendered control section) show only
+#: the newest N decisions — the cli statusz cap; the full ledger stays
+#: machine-readable in report["control"] / --json
+MAX_NOTE_DECISIONS = 8
+
+
+def _control_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """The observe->act loop's decisions, from the merged timeline's
+    ``control_decision`` events (obs/control emits one per record AND
+    one per resolve; the resolve carries the final outcome, so the
+    LAST event per decision id wins) plus the cluster-aggregated
+    decision counters."""
+    decisions: Dict[Any, Dict[str, Any]] = {}
+    for e in _events(doc):
+        if e.get("name") != "control_decision":
+            continue
+        args = e.get("args") or {}
+        # the pid is part of the identity: decision ids are PER-PROCESS
+        # ledger sequences, so two hosts' decision #1 must not clobber
+        # each other in the merged /clusterz doc
+        did = (e.get("pid"), args.get("controller"),
+               args.get("decision_id"))
+        decisions[did] = {
+            "controller": args.get("controller"),
+            "task": args.get("task"),
+            "id": args.get("decision_id"),
+            "outcome": args.get("outcome"),
+            "evidence": args.get("evidence"),
+            "action": args.get("action"),
+            "outcome_evidence": args.get("outcome_evidence"),
+            "note": args.get("note"),
+            # the merged-timeline event stamp: RECENCY across
+            # processes, where raw ids (per-process sequences) cannot
+            # order anything
+            "ts": e.get("ts"),
+        }
+    counts: Dict[str, Dict[str, float]] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name != "mrtpu_control_decisions_total":
+            continue
+        c = counts.setdefault(labels.get("controller", "?"), {})
+        o = labels.get("outcome", "?")
+        c[o] = c.get(o, 0.0) + value
+    out: Dict[str, Any] = {}
+    if decisions:
+        # TIMELINE order, newest last: the human surfaces cap to the
+        # list tail, and a (controller, id) sort would put the
+        # alphabetically-last controller's stale decisions there
+        out["decisions"] = sorted(
+            decisions.values(),
+            key=lambda d: (d.get("ts") or 0, str(d["controller"]),
+                           d["id"] or 0))
+    if counts:
+        out["counts"] = counts
+    return out
+
+
+def _acted_on(control: Dict[str, Any], controller: str,
+              **match: Any) -> Optional[Dict[str, Any]]:
+    """The newest decision of *controller* whose fields match —
+    findings cross-reference this so a skew/straggler that was already
+    acted on says so instead of re-alarming."""
+    best = None
+    for d in control.get("decisions") or []:
+        if d.get("controller") != controller:
+            continue
+        if d.get("outcome") in ("refused", "error"):
+            continue  # a refused decision did not act on anything
+        ok = True
+        for field, want in match.items():
+            have = d.get(field)
+            if field == "worker":
+                have = (d.get("evidence") or {}).get("worker")
+            if str(have) != str(want):
+                ok = False
+                break
+        # recency by the merged-timeline stamp, not the raw id: ids
+        # are per-process sequences, so process A's #50 must not beat
+        # process B's newer #3
+        if ok and (best is None or (d.get("ts") or 0)
+                   >= (best.get("ts") or 0)):
+            best = d
+    return best
+
+
+def _acted_summary(dec: Dict[str, Any]) -> str:
+    """One-line "already acted on" rendering of a decision."""
+    oe = dec.get("outcome_evidence") or {}
+    ev = dec.get("evidence") or {}
+    if (dec.get("controller") == "repartition"
+            and oe.get("imbalance_recv_after") is not None):
+        return ("rebalanced: imbalance {:.1f}x -> {:.1f}x ({})".format(
+            float(ev.get("imbalance_recv") or 0.0),
+            float(oe["imbalance_recv_after"]), dec.get("outcome")))
+    note = dec.get("note") or ""
+    return "{} ({})".format(note or "decision applied",
+                            dec.get("outcome"))
+
+
 # -- comms: exchange imbalance + upload/compute overlap ----------------------
 
 #: recv-side imbalance (max over mean) at or above this reads as an
@@ -779,6 +881,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
     cluster = doc.get("mrtpuCluster") or {}
     stragglers, workers, latency_source = _find_stragglers(doc)
     comms = _comms_findings(doc)
+    control = _control_findings(doc)
     report: Dict[str, Any] = {
         "aligned_to": cluster.get("aligned_to"),
         "n_procs": len(cluster.get("procs") or {}) or None,
@@ -795,11 +898,46 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "sched": _sched_findings(doc),
         "slo": _slo_findings(doc),
         "durability": _durability_findings(doc),
+        "control": control,
         "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
         "trace_events": len(doc.get("traceEvents") or []),
     }
+    # decision-aware findings: a skew/straggler the control plane
+    # already acted on is annotated instead of re-alarming — the
+    # "what changed since" cli diagnose previously could not answer
+    for s in report["skew"]:
+        dec = _acted_on(control, "repartition", task=s.get("task"))
+        if dec is not None:
+            s["acted"] = _acted_summary(dec)
+    for s in report["stragglers"]:
+        dec = _acted_on(control, "reclaim", worker=s.get("worker"))
+        if dec is not None:
+            s["acted"] = _acted_summary(dec)
     notes: List[str] = []
+    # newest MAX_NOTE_DECISIONS only (the cli statusz cap): an active
+    # reclaimer/advisor writes one ledger row per decision, and
+    # hundreds of "control:" lines would drown the skew/straggler
+    # findings the report exists to surface — the full list stays in
+    # report["control"] (--json / the render's control section)
+    all_decisions = control.get("decisions") or []
+    for d in all_decisions[-MAX_NOTE_DECISIONS:]:
+        note = d.get("note") or (
+            f"{d.get('controller')} decision on task {d.get('task')}")
+        oe = d.get("outcome_evidence") or {}
+        if (d.get("controller") == "repartition"
+                and oe.get("imbalance_recv_after") is not None):
+            note += ": imbalance {:.1f}x -> {:.1f}x".format(
+                float((d.get("evidence") or {})
+                      .get("imbalance_recv") or 0.0),
+                float(oe["imbalance_recv_after"]))
+        elif d.get("outcome") in ("improved", "neutral", "regressed"):
+            note += f" [{d['outcome']}]"
+        notes.append("control: " + note)
+    if len(all_decisions) > MAX_NOTE_DECISIONS:
+        notes.append("control: (+{} earlier decisions in the control "
+                     "section)".format(
+                         len(all_decisions) - MAX_NOTE_DECISIONS))
     for task, ex in sorted((comms.get("exchange") or {}).items()):
         if ex["imbalance_recv"] >= EXCHANGE_IMBALANCE_NOTE_RATIO:
             hot = ex["hot_dst"]
@@ -807,6 +945,17 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
                 hot = int(str(hot).lstrip("DP"))
             except ValueError:
                 pass
+            dec = _acted_on(control, "repartition", task=task)
+            if dec is not None:
+                # acted on: the cumulative matrix still carries the
+                # pre-rebalance history — say what changed instead of
+                # re-alarming on stale totals
+                notes.append(
+                    "exchange imbalance {:.1f}x on task {} (cumulative) "
+                    "— already acted on: {}".format(
+                        ex["imbalance_recv"], task,
+                        _acted_summary(dec)))
+                continue
             notes.append(
                 "exchange imbalance {:.1f}x on task {}: device {} "
                 "receives {:.0%} of records".format(
@@ -963,7 +1112,9 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
             lines.append(
                 "  worker {worker}: median job {median_s:.3f}s over "
                 "{jobs} job(s) — {ratio}x everyone else's median "
-                "({baseline_median_s:.3f}s)".format(**s))
+                "({baseline_median_s:.3f}s)".format(**s)
+                + ("  [acted: {}]".format(s["acted"])
+                   if s.get("acted") else ""))
     else:
         lines.append("stragglers: none detected")
 
@@ -977,7 +1128,9 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
                 "({ratio_vs_uniform}x uniform over "
                 "{partitions_observed} partitions)".format(**s)
                 + (" [via exchange matrix]"
-                   if s.get("source") == "exchange_matrix" else ""))
+                   if s.get("source") == "exchange_matrix" else "")
+                + ("  [acted: {}]".format(s["acted"])
+                   if s.get("acted") else ""))
     else:
         lines.append("partition skew: none detected")
 
@@ -1057,6 +1210,23 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
             lines.append(f"  tenant {t}: {parts}")
         for t, n in sorted((sched.get("served_records") or {}).items()):
             lines.append(f"  tenant {t}: {n} records served")
+
+    ctrl = report.get("control") or {}
+    if ctrl.get("decisions") or ctrl.get("counts"):
+        lines.append("control plane (observe->act):")
+        for c, by_o in sorted((ctrl.get("counts") or {}).items()):
+            lines.append("  {}: {}".format(c, "  ".join(
+                f"{o}={int(n)}" for o, n in sorted(by_o.items()))))
+        decs = ctrl.get("decisions") or []
+        for d in decs[-MAX_NOTE_DECISIONS:]:
+            lines.append(
+                "  [{}] task {} #{}: {} -> {}".format(
+                    d.get("controller"), d.get("task"), d.get("id"),
+                    d.get("note") or "decision", d.get("outcome")))
+        if len(decs) > MAX_NOTE_DECISIONS:
+            lines.append("  (+{} earlier decisions; --json for the "
+                         "full ledger)".format(
+                             len(decs) - MAX_NOTE_DECISIONS))
 
     comp = report.get("compile_hotspots") or []
     if comp:

@@ -191,6 +191,12 @@ DIAG_FAMILIES = frozenset({
     "mrtpu_client_failovers_total",
     "mrtpu_session_spills_total", "mrtpu_session_restores_total",
     "mrtpu_session_backpressure_total",
+    # the control plane (obs/control + engine/autotune): every
+    # automatic decision's controller/outcome counts roll up
+    # cluster-wide so diagnose and /clusterz see the observe->act loop
+    # wherever it ran (the decisions themselves travel as
+    # control_decision spans on the merged timeline)
+    "mrtpu_control_decisions_total",
 })
 
 #: diagnosis gauges that must merge across processes by MAX, not sum:

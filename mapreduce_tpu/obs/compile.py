@@ -250,6 +250,8 @@ class CompileLedger:
         #: /statusz scrapes (typically every second) from this instead
         #: of re-parsing the whole registry file per scrape
         self._disk_count_cache: Optional[Tuple[str, int, int]] = None
+        self._disk_buckets_cache: Optional[
+            Tuple[str, int, Dict[str, Dict[str, Any]]]] = None
         if max_executables is None:
             max_executables = int(os.environ.get(
                 "MAPREDUCE_TPU_EXEC_CACHE", "32"))
@@ -314,9 +316,25 @@ class CompileLedger:
                      dir: Optional[str] = None,
                      ) -> Dict[str, Dict[str, Any]]:
         """The on-disk shape registry next to the (given or configured)
-        cache dir; empty when no cache dir is configured."""
+        cache dir; empty when no cache dir is configured.  Mtime-cached
+        like :meth:`_disk_count`: the capacity controller consults this
+        at every autotuned run entry, which must not cost a full JSON
+        parse in steady state (callers treat the result as read-only)."""
         path = registry_path(dir)
-        return self._load_disk(path) if path else {}
+        if not path:
+            return {}
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            return {}
+        with self._lock:
+            cached = self._disk_buckets_cache
+        if cached and cached[0] == path and cached[1] == mtime:
+            return cached[2]
+        buckets = self._load_disk(path)
+        with self._lock:
+            self._disk_buckets_cache = (path, mtime, buckets)
+        return buckets
 
     def _disk_count(self, cdir: str) -> int:
         """Bucket count of the on-disk registry, mtime-cached: the

@@ -458,6 +458,22 @@ def write_bundle(out_dir: str, store: Any = None,
                   encoding="utf-8") as f:
             json.dump(slo_doc, f, indent=1, default=float)
         files.append("slo.json")
+    # the control plane (obs/control): every automatic decision with
+    # its evidence and measured outcome — strict-validated on write AND
+    # reload.  Only written when some controller actually decided
+    # something: an empty file would read as "the loop ran and did
+    # nothing", which a controllers-disabled run must not claim.
+    from .control import control_snapshot, validate_control
+
+    ctrl_snap = control_snapshot()
+    if ctrl_snap:
+        ctrl_doc = {"kind": "mrtpu-control", "version": 1,
+                    "snapshot": ctrl_snap}
+        validate_control(ctrl_doc)
+        with open(os.path.join(out_dir, "control_ledger.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(ctrl_doc, f, indent=1, default=float)
+        files.append("control_ledger.json")
     if cluster_doc is not None:
         from .analysis import diagnose
 
@@ -535,6 +551,14 @@ def load_bundle(path: str) -> Dict[str, Any]:
             slo_doc = json.load(f)
         validate_slo(slo_doc)
         out["slo"] = slo_doc
+    ctrl_path = os.path.join(path, "control_ledger.json")
+    if os.path.exists(ctrl_path):
+        from .control import validate_control
+
+        with open(ctrl_path, encoding="utf-8") as f:
+            ctrl_doc = json.load(f)
+        validate_control(ctrl_doc)
+        out["control_ledger"] = ctrl_doc
     cluster_path = os.path.join(path, "cluster_trace.json")
     if os.path.exists(cluster_path):
         with open(cluster_path, encoding="utf-8") as f:

@@ -170,6 +170,17 @@ def comms_snapshot_section() -> Dict[str, Any]:
     return comms_snapshot()
 
 
+def control_snapshot_section() -> Dict[str, Any]:
+    """The control section of /statusz (obs/control): the process's
+    control-ledger decisions — evidence, action, measured outcome —
+    and per-controller outcome counts.  Empty when no controller ever
+    decided anything, so a controllers-disabled run provably shows
+    nothing."""
+    from .control import control_snapshot
+
+    return control_snapshot()
+
+
 def slo_snapshot_section(collector=None) -> Dict[str, Any]:
     """The SLO section of /statusz (obs/slo): per-tenant objective
     percentiles, error budget and burn rates, evaluated at scrape time
@@ -214,6 +225,9 @@ def cluster_status(store, now: Optional[float] = None,
     slo_sec = slo_snapshot_section(collector=collector)
     if slo_sec:
         out["slo"] = slo_sec
+    ctrl = control_snapshot_section()
+    if ctrl:
+        out["control"] = ctrl
     if scheduler is not None:
         sched = scheduler.snapshot()
         if sched:

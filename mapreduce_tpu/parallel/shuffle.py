@@ -40,7 +40,8 @@ class Exchanged(NamedTuple):
 def partition_exchange(keys: jax.Array, values: jax.Array,
                        payload: jax.Array, valid: jax.Array,
                        axis_name: str, capacity: int,
-                       carry: Optional[Tuple] = None) -> Exchanged:
+                       carry: Optional[Tuple] = None,
+                       pmap: Optional[jax.Array] = None) -> Exchanged:
     """Exchange records so device ``p`` ends up with every record whose
     ``key_hi % P == p``.  Must run inside ``shard_map`` over *axis_name*.
 
@@ -55,10 +56,26 @@ def partition_exchange(keys: jax.Array, values: jax.Array,
     and the fold order stays ``acc ⊕ wave`` — letting the caller's
     merge reduce accumulator + fresh records in ONE pass with no extra
     dispatch or concatenate allocation outside the compiled program.
+
+    ``pmap`` (the skew-control hook, engine/autotune.py) generalizes
+    the partition function to an indirection table: a replicated
+    ``[B] int32`` array mapping hash bucket ``key_hi % B`` to its
+    destination partition.  The identity table
+    (``pmap[b] = b % P``, with ``P | B``) reproduces ``key_hi % P``
+    EXACTLY — ``(k % B) % P == k % P`` whenever P divides B — so a run
+    that never rebalances is bit-identical to ``pmap=None``; a
+    rebalanced table routes each hot bucket wherever the controller
+    binned it, inside the same compiled program (the table is an
+    input, not a constant — no recompile per rebalance).
     """
     P = jax.lax.psum(1, axis_name)
     n = keys.shape[0]
-    dest = (keys[:, 0] % jnp.uint32(P)).astype(jnp.int32)
+    if pmap is None:
+        dest = (keys[:, 0] % jnp.uint32(P)).astype(jnp.int32)
+    else:
+        B = pmap.shape[0]
+        bucket = (keys[:, 0] % jnp.uint32(B)).astype(jnp.int32)
+        dest = pmap[bucket].astype(jnp.int32)
     dest = jnp.where(valid, dest, P)  # invalid -> out-of-range, dropped
 
     # rank of each row within its destination bucket, via one-hot cumsum:

@@ -214,12 +214,21 @@ class Scheduler:
                  config: SchedulerConfig = SchedulerConfig(),
                  lease: Optional[SchedulerLease] = None,
                  use_lease: bool = True,
-                 holder: Optional[str] = None) -> None:
+                 holder: Optional[str] = None,
+                 advisor: Optional[Any] = None) -> None:
         self.store = store
         self.config = config
         self.lease = lease if lease is not None else (
             SchedulerLease(_SchedCnn(store), holder=holder)
             if use_lease else None)
+        #: telemetry-informed admission (engine/autotune.
+        #: AdmissionAdvisor): when session hosts register their mesh
+        #: placements, an admitted task is ROUTED to the mesh whose
+        #: compile ledger is warm for its program and whose HBM gauges
+        #: show headroom (the pick lands in the control ledger as an
+        #: admission decision).  None — the default — admits exactly
+        #: as before.
+        self.advisor = advisor
         self._lock = threading.Lock()
 
     # -- submit (admission control) ---------------------------------------
@@ -438,6 +447,23 @@ class Scheduler:
                               "generation": gen}})
                 if doc is None:
                     continue  # cancelled in the race; re-read the queue
+                if self.advisor is not None:
+                    # telemetry-informed routing: prefer a mesh whose
+                    # compile ledger is warm for this task's program
+                    # and whose HBM gauges show headroom — the pick
+                    # (with its per-candidate evidence) is a recorded
+                    # control decision; with nothing registered the
+                    # task routes exactly as before
+                    program = str((cand.get("params") or {})
+                                  .get("program")
+                                  or cand.get("kind") or "-")
+                    mesh = self.advisor.choose(program, tenant=tenant,
+                                               task=doc["_id"])
+                    if mesh is not None:
+                        self.store.update(TASKS_COLL,
+                                          {"_id": doc["_id"]},
+                                          {"$set": {"mesh": mesh}})
+                        doc["mesh"] = mesh
                 # queue wait (submit->admitted): exact monotonic when
                 # this process saw the submit, else the board's
                 # persisted stamps (cross-process degradation, the

@@ -95,8 +95,20 @@ class Server:
     def __init__(self, connstr: str, dbname: str,
                  auth: Optional[Any] = None,
                  job_lease: Optional[float] = None,
-                 retry: Optional[Any] = None) -> None:
+                 retry: Optional[Any] = None,
+                 reclaim: Optional[Any] = None) -> None:
         self.cnn = Connection(connstr, dbname, auth, retry=retry)
+        #: straggler-driven speculative re-claim (engine/autotune.
+        #: SpeculativeReclaimer) — None (the default) keeps the reap
+        #: loop exactly as before; the CLI surfaces attach one behind
+        #: --speculative-reclaim.  Every re-claim lands in the control
+        #: ledger; exactly-once rides the existing claim-guard fencing.
+        self.reclaim = reclaim
+        #: capacity autotuning for the device fast path (engine/
+        #: autotune.AutoTuner) — None keeps the engine's hand-tuned
+        #: config; the CLI surfaces attach one so a mis-tuned start
+        #: converges across runs instead of re-paying retries
+        self.autotune = None
         self.task = Task(self.cnn, **(
             {"job_lease": job_lease} if job_lease is not None else {}))
         self.params: Dict[str, Any] = {}
@@ -191,6 +203,13 @@ class Server:
             if reaped:
                 logger.warning("%s: reaped %d expired job leases", phase,
                                reaped)
+            if self.reclaim is not None:
+                # straggler-driven speculative re-claim (observe->act):
+                # a RUNNING job held far beyond every other worker's
+                # completed-job profile is broken back to claimable
+                # BEFORE its lease expires; the deposed worker fences
+                # at its next heartbeat/emit (the PR-1 machinery)
+                self.reclaim.scan(store, coll)
             # BROKEN with repetitions >= cap -> FAILED (server.lua:192-206)
             store.update(
                 coll,
@@ -210,6 +229,12 @@ class Server:
                 logger.info("%s %.1f%% (%d/%d)", phase, pct, done, total)
                 last_pct = pct
             if done >= total:
+                if self.reclaim is not None:
+                    # the phase drained: resolve still-pending
+                    # re-claims from the final docs — scan() never
+                    # runs for this coll again, and a pending ledger
+                    # row must not outlive its phase
+                    self.reclaim.finish(store, coll)
                 return
             time.sleep(self.poll_sleep)
 
@@ -262,7 +287,8 @@ class Server:
             # the task database name is the engine's accounting label:
             # its waves/seconds/FLOPs roll up per task in the collector
             self._device_engine = DeviceEngine(mesh, ds.map_fn, cfg,
-                                               task=self.cnn.dbname)
+                                               task=self.cnn.dbname,
+                                               autotune=self.autotune)
         return self._device_engine
 
     def _run_device_phase(self) -> None:

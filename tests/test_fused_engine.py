@@ -462,3 +462,79 @@ def test_exchange_stats_off_disables_matrix(mesh):
     assert "exchange_records" not in tm and "exchange" not in tm
     # the overlap fraction is span-derived, not matrix-derived: still on
     assert 0.0 <= tm["upload_overlap_frac"] <= 1.0
+
+
+# -- the partition map (skew-aware repartition, engine/autotune) -------------
+
+def test_identity_partition_map_bit_identical(mesh):
+    """The golden bit-identity pin for EngineConfig.partition_map: the
+    identity bucket->partition table computes ``(k % B) % P == k % P``
+    exactly (P | B), so turning the feature on — one more replicated
+    program input — must never change a fold value, bit for bit."""
+    rng = np.random.default_rng(31)
+    chunks = _chunks(rng, 3 * mesh.shape["data"] * 2)
+    # one monoid (suite budget): the table only picks DESTINATIONS —
+    # it has no monoid interaction, and the fold golden keeps the full
+    # op breadth where the monoid IS the subject.  The pm=False side
+    # shares its executable with the exchange-stats golden's config.
+    for op in ("sum",):
+        results = []
+        for pm in (False, True):
+            cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                               out_capacity=256, reduce_op=op,
+                               partition_map=pm)
+            res = DeviceEngine(mesh, _records_map_fn, cfg).run(
+                chunks, waves=3, max_retries=0)
+            assert res.overflow == 0
+            results.append(res)
+        on, off = results
+        for field in ("keys", "values", "payload", "valid"):
+            a, b = np.asarray(getattr(on, field)), \
+                np.asarray(getattr(off, field))
+            assert np.array_equal(a, b), (op, field)
+        assert _result_dict(on) == _dict_oracle(chunks, op)
+
+
+def test_midstream_rebalance_bit_identical_to_fresh_run(mesh):
+    """The repartition correctness guard (ISSUE 14 satellite): feeding
+    half a stream under the identity map, rebalancing to table M, and
+    feeding the rest must be BIT-identical to a from-scratch session
+    that ran under M from wave 0 — re-binning the resident accumulator
+    (repartition_rows with the pmap indirection) plus re-routing
+    future waves reproduces the from-scratch layout exactly."""
+    from mapreduce_tpu.engine.device_engine import identity_pmap
+    from mapreduce_tpu.engine.session import EngineSession
+
+    rng = np.random.default_rng(37)
+    chunks = _chunks(rng, 4 * mesh.shape["data"])
+    half = chunks.shape[0] // 2
+    cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                       out_capacity=256, reduce_op="sum",
+                       partition_map=True)
+    n_dev = mesh.shape["data"]
+    sess = EngineSession(mesh, _records_map_fn, cfg, k=2)
+    sess.feed(chunks[:half], task="t")
+    pm = (identity_pmap(sess.engine.partition_buckets, n_dev)
+          + 3) % n_dev  # a genuine remap: every bucket moves
+    sess.rebalance("t", pm)
+    sess.feed(chunks[half:], task="t")
+    mid = sess.snapshot("t")
+    assert sess.stats("t")["rebalances"] == 1
+    sess.close()
+
+    fresh = EngineSession(mesh, _records_map_fn, cfg, k=2)
+    fresh.feed(chunks[:0], task="t")   # latch the shape, create stream
+    fresh.rebalance("t", pm)           # install M before any rows
+    fresh.feed(chunks[:half], task="t")
+    fresh.feed(chunks[half:], task="t")
+    scratch = fresh.snapshot("t")
+    fresh.close()
+
+    for field in ("keys", "values", "payload", "valid"):
+        a = np.asarray(getattr(mid, field))
+        b = np.asarray(getattr(scratch, field))
+        assert np.array_equal(a, b), (
+            f"mid-stream rebalance diverged from from-scratch on "
+            f"{field}")
+    assert mid.overflow == scratch.overflow == 0
+    assert _result_dict(mid) == _dict_oracle(chunks, "sum")

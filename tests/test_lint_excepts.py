@@ -218,6 +218,13 @@ _MONOTONIC_ONLY_MODULES = {
     # are minted through coord/docstore.now inside coord/lease.py)
     os.path.join("mapreduce_tpu", "coord", "ha.py"),
     os.path.join("mapreduce_tpu", "engine", "spill.py"),
+    # the control plane: decision ages are durations, control_decision
+    # tracer events are span data, and the controllers time control
+    # windows — the whole observe->act loop is monotonic-only (its one
+    # persisted wall timestamp and the job-stamp comparisons the
+    # reclaimer does are minted/read through coord/docstore.now)
+    os.path.join("mapreduce_tpu", "obs", "control.py"),
+    os.path.join("mapreduce_tpu", "engine", "autotune.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions
