@@ -77,6 +77,17 @@ def gate_specs():
         MetricSpec("value", rel_tol=0.50, required=True),
         MetricSpec("europarl_wordcount_compute_s", rel_tol=0.50,
                    required=True),
+        # the Pallas hot path (ops/segscan + ops/tokenize, PR 15): the
+        # timed run serves the fused kernels (bench_engine_config sets
+        # segment_impl/tokenize_impl='pallas', bit-identical to lax —
+        # the smoke's pallas gate pins it) and reports its MFU as a
+        # gated top-level key.  Higher is better; the tolerance is WIDE
+        # (down to 10% of the median) because the history mixes
+        # platforms — the seed is a CPU-mesh measurement and a real TPU
+        # raises the bar as it appends.  REQUIRED so a run that stops
+        # reporting the kernel-served utilisation fails loudly.
+        MetricSpec("wordcount_mfu", rel_tol=0.90, direction="higher",
+                   required=True),
         MetricSpec("timings.compute_s", rel_tol=0.35),
         MetricSpec("timings.readback_s", rel_tol=1.00),
         MetricSpec("timings.materialize_s", rel_tol=1.50),
@@ -999,6 +1010,84 @@ def check_smoke() -> int:
         f"({new_obs} new backend_compile observation(s)) — the "
         "executable cache is not serving it")
 
+    # Pallas hot-path gate (ops/segscan + ops/tokenize; registry- and
+    # ledger-asserted, zero wall-clock comparisons): a kernel-config
+    # smoke run must (1) actually build the two hot-path kernels
+    # (trace-time build counter, interpret mode on this CPU tier),
+    # (2) keep the fused execution model — still exactly one
+    # wave-program dispatch per wave, zero merge dispatches, (3) fold
+    # bit-identically to the lax smoke run above (same corpus, same
+    # wave split, same capacities), (4) land a wave bucket whose config
+    # token names the pallas impls in the compile ledger, and (5) carry
+    # the MFU the gated wordcount_mfu key is derived from.
+    from mapreduce_tpu.obs.compile import LEDGER
+    from mapreduce_tpu.ops import segscan as _segscan
+    from mapreduce_tpu.ops import tokenize as _tokenize_mod
+
+    kb_seg0 = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                           kernel="segreduce")
+    kb_tok0 = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                           kernel="tokenize")
+    pw0 = REGISTRY.sum("mrtpu_device_waves_total")
+    pd0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    # capacities SMALLER than the lax smoke engine's on purpose: the
+    # fold result is capacity-independent below overflow (6 uniques),
+    # and the smaller static shapes keep this extra compile cheap on
+    # the CPU tier (suite-budget sizing)
+    wc_p = DeviceWordCount(
+        make_mesh(), chunk_len=4096,
+        config=EngineConfig(local_capacity=1024, exchange_capacity=512,
+                            out_capacity=1024, tile=512, tile_records=128,
+                            combine_in_scan=True,
+                            segment_impl="pallas", tokenize_impl="pallas",
+                            segment_block=2048, tokenize_block=2048))
+    tm_p = {}
+    counts_p = wc_p.count_bytes(corpus, timings=tm_p, waves=3)
+    assert counts_p == counts, (
+        "pallas kernel-config fold diverged from the lax smoke run")
+    assert tm_p["retries"] == 0, tm_p
+    p_waves = REGISTRY.sum("mrtpu_device_waves_total") - pw0
+    p_disp = (REGISTRY.sum("mrtpu_device_dispatches_total",
+                           program="wave") - pd0)
+    assert p_waves == tm_p["waves"] >= 2 and p_disp == p_waves, (
+        f"pallas config broke one-dispatch-per-wave: {p_disp} dispatches "
+        f"for {p_waves} waves")
+    assert REGISTRY.sum("mrtpu_device_dispatches_total",
+                        program="merge") == 0
+    kb_seg = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                          kernel="segreduce") - kb_seg0
+    kb_tok = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                          kernel="tokenize") - kb_tok0
+    assert kb_seg >= 1 and kb_tok >= 1, (
+        f"kernel-config run built no hot-path kernels (segreduce "
+        f"{kb_seg}, tokenize {kb_tok}) — the config did not dispatch "
+        "the kernel programs")
+    pallas_buckets = [
+        rec for rec in LEDGER.buckets()
+        if rec.get("program") == "wave"
+        and any("'pallas'" in e for e in rec.get("extra", []))]
+    assert pallas_buckets, (
+        "no wave bucket in the compile ledger carries the pallas config "
+        "token — the kernel config never compiled a wave program")
+    assert tm_p.get("mfu") is not None and tm_p["flops"] > 0, (
+        f"pallas-served run carries no MFU in its timings: {tm_p}")
+    # interpret-mode policy sanity: off-TPU, the kernels must have been
+    # built under the interpreter (CPU numbers validate semantics)
+    import jax as _jax
+
+    if _jax.default_backend() != "tpu":
+        assert REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                            mode="interpret") >= kb_seg + kb_tok
+    # the gated key must be seeded in history (main() derives
+    # wordcount_mfu from the kernel-served best run)
+    assert any(benchgate.lookup(h, "wordcount_mfu") is not None
+               for h in history), (
+        "no BENCH.json history entry carries 'wordcount_mfu'")
+    # the ops-level defaults stay importable constants (block sizes ride
+    # the config fingerprint; a drifted default is a silent recompile)
+    assert _segscan.SEGMENT_BLOCK % 128 == 0
+    assert _tokenize_mod.TOKENIZE_BLOCK % 128 == 0
+
     # always-on-service gate (registry-only): the sustained mode runs
     # with the SESSION layer active — the fused execution model must
     # hold there too (exactly one wave-program dispatch per session
@@ -1247,6 +1336,9 @@ def check_smoke() -> int:
         "dispatches_per_wave": dispatches / waves_ran,
         "device_flops_recorded": flops,
         "mfu_gauge": REGISTRY.value("mrtpu_device_mfu"),
+        "pallas_fold_identical": True,
+        "pallas_kernel_builds": {"segreduce": kb_seg, "tokenize": kb_tok},
+        "pallas_mfu": tm_p.get("mfu"),
         "second_build_cached": cached_delta,
         "sustained_records_per_s": sustained["sustained_records_per_s"],
         "submit_first_snapshot_p99_s":
@@ -1504,6 +1596,12 @@ def main() -> None:
         "mfu": best.get("mfu"),
         "roofline_frac": best.get("roofline_frac"),
         "cost_source": best.get("cost_source"),
+        # the gated Pallas hot-path key: the kernel-served run's MFU
+        # (bench_engine_config serves segment_impl/tokenize_impl=
+        # 'pallas'), as its own REQUIRED higher-is-better top-level key
+        "wordcount_mfu": best.get("mfu"),
+        "segment_impl": wc.config.segment_impl,
+        "tokenize_impl": wc.config.tokenize_impl,
         # the gated warm-start keys (ROADMAP 2(c))
         "cold_compile_s": coldwarm["cold_compile_s"],
         "warm_start_s": coldwarm["warm_start_s"],

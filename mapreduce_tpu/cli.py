@@ -357,6 +357,19 @@ def cmd_wordcount(argv: List[str]) -> int:
                         "tier-1 when its background compile lands "
                         "(first results in the small compile's time); "
                         "default is the module's config (variadic)")
+    p.add_argument("--segment-impl", choices=("lax", "pallas"),
+                   default=None,
+                   help="device-engine segmented-reduce formulation "
+                        "(ops/segscan): 'pallas' serves the fused "
+                        "VMEM-tiled kernel, bit-identical to 'lax' "
+                        "(the default); off-TPU the kernel runs under "
+                        "the Pallas interpreter — semantics, not speed")
+    p.add_argument("--tokenize-impl", choices=("lax", "pallas"),
+                   default=None,
+                   help="device-engine tokenizer formulation "
+                        "(ops/tokenize): 'pallas' fuses classify + "
+                        "hash scans + boundary cummax into one blocked "
+                        "kernel pass, bit-identical to 'lax' (default)")
     p.add_argument("--workers", type=int, default=4)
     p.add_argument("--num-reducers", type=int, default=15)
     p.add_argument("--autotune", dest="autotune", action="store_true",
@@ -401,9 +414,15 @@ def cmd_wordcount(argv: List[str]) -> int:
         params["device"] = True
         if args.sort_impl:
             params["init_args"]["device_sort_impl"] = args.sort_impl
-    elif args.sort_impl:
-        print("WARNING: --sort-impl only affects the device engine "
-              "(--device); the host path ignores it", file=sys.stderr)
+        if args.segment_impl:
+            params["init_args"]["device_segment_impl"] = args.segment_impl
+        if args.tokenize_impl:
+            params["init_args"]["device_tokenize_impl"] = \
+                args.tokenize_impl
+    elif args.sort_impl or args.segment_impl or args.tokenize_impl:
+        print("WARNING: --sort-impl/--segment-impl/--tokenize-impl only "
+              "affect the device engine (--device); the host path "
+              "ignores them", file=sys.stderr)
     if not args.device:
         from .worker import spawn_worker_threads
 
@@ -1659,13 +1678,28 @@ def cmd_warmup(argv: List[str]) -> int:
                         "= both — a fully warmed machine never serves "
                         "tier-0, because the tiered engine's warmness "
                         "probe finds tier-1 primed and skips tiering")
+    p.add_argument("--segment-impl", choices=("lax", "pallas"),
+                   default=None,
+                   help="prime the wave program with this segmented-"
+                        "reduce formulation (ops/segscan) instead of "
+                        "the config default — so the registry/cache "
+                        "hold the kernel bucket a pallas-served run "
+                        "will look up (with --bench the bench config "
+                        "already selects 'pallas')")
+    p.add_argument("--tokenize-impl", choices=("lax", "pallas"),
+                   default=None,
+                   help="prime with this tokenizer formulation "
+                        "(ops/tokenize); see --segment-impl")
     p.add_argument("--replay", action="store_true",
                    help="additionally AOT-prime EVERY bucket the shape "
                         "registry (obs/compile, written next to the "
                         "cache) ever recorded on this machine — "
                         "restarting workers and capacity retries then "
                         "hit warm programs whatever shapes they ran "
-                        "before, not just the wordcount default")
+                        "before (kernel-config buckets included: the "
+                        "replay spec records segment/tokenize impls "
+                        "with the rest of the config), not just the "
+                        "wordcount default")
     _add_verbosity(p)
     args = p.parse_args(argv)
     _setup_logging(args.verbose or 1)
@@ -1698,6 +1732,12 @@ def cmd_warmup(argv: List[str]) -> int:
     wc.config = _dc_replace(
         wc.config, sort_impl={"0": "argsort", "1": "variadic",
                               "both": "tiered"}[args.tier])
+    if args.segment_impl:
+        wc.config = _dc_replace(wc.config,
+                                segment_impl=args.segment_impl)
+    if args.tokenize_impl:
+        wc.config = _dc_replace(wc.config,
+                                tokenize_impl=args.tokenize_impl)
     secs = wc.warm()
     # the seconds land in the metrics registry (mrtpu_compile_seconds /
     # mrtpu_compile_total via the ledger), not just stdout

@@ -169,6 +169,26 @@ class EngineConfig:
     #: buckets in the indirection table (0 = auto: PARTITION_MAP_GRANULARITY
     #: per device) — more buckets = finer-grained rebalancing
     partition_buckets: int = 0
+    #: post-sort segmented-reduce formulation (ops/segscan):
+    #:   'lax'    — the shifted-compare + segmented_scan ladder +
+    #:     ladder_cumsum chain (log2(N) full-array passes per ladder);
+    #:   'pallas' — the fused VMEM-tiled kernel: boundary detection,
+    #:     segmented combine / run-length count, and the run-end
+    #:     cumulative count in ONE pass, bit-identical (golden suite).
+    #: Selected per config so the equivalence suite pins both; the CPU
+    #: tier runs the kernel under the Pallas interpreter
+    #: (ops/pallas_compat's ONE interpret-mode policy).
+    segment_impl: str = "lax"
+    #: elements per segmented-reduce kernel block (multiple of 128);
+    #: part of the cache key so block retunes recompile cleanly
+    segment_block: int = 4096
+    #: tokenizer formulation for map_fns that tokenize (the wordcount
+    #: family reads it): 'lax' = the tiled Hillis-Steele affine ladders,
+    #: 'pallas' = the fused tokenizing map-scan kernel (classify + all
+    #: hash lanes + boundary cummax in one blocked pass, bit-identical)
+    tokenize_impl: str = "lax"
+    #: bytes per tokenize kernel block (multiple of 128)
+    tokenize_block: int = 4096
 
     def cache_key(self):
         # the op object itself is part of the key: keeping it in the
@@ -179,7 +199,9 @@ class EngineConfig:
                 self.reduce_op, self.unit_values, self.combine_in_scan,
                 self.combine_capacity, self.rank_sort,
                 self.exchange_stats, self.sort_impl,
-                self.partition_map, self.partition_buckets)
+                self.partition_map, self.partition_buckets,
+                self.segment_impl, self.segment_block,
+                self.tokenize_impl, self.tokenize_block)
 
     def scan_combine_slots(self, T: int) -> int:
         """Static buffer slots one chunk's pre-reduced records occupy
@@ -205,6 +227,8 @@ def _wave_donate_argnums(cfg: "EngineConfig"):
 
 
 _SORT_IMPLS = ("variadic", "argsort", "tiered")
+_SEGMENT_IMPLS = ("lax", "pallas")
+_TOKENIZE_IMPLS = ("lax", "pallas")
 
 #: auto bucket count per device for the partition-map indirection
 #: table: enough granularity that a single hot partition's buckets can
@@ -445,6 +469,14 @@ class DeviceEngine:
             raise ValueError(
                 f"EngineConfig.sort_impl must be one of {_SORT_IMPLS}, "
                 f"got {config.sort_impl!r}")
+        if config.segment_impl not in _SEGMENT_IMPLS:
+            raise ValueError(
+                f"EngineConfig.segment_impl must be one of "
+                f"{_SEGMENT_IMPLS}, got {config.segment_impl!r}")
+        if config.tokenize_impl not in _TOKENIZE_IMPLS:
+            raise ValueError(
+                f"EngineConfig.tokenize_impl must be one of "
+                f"{_TOKENIZE_IMPLS}, got {config.tokenize_impl!r}")
         self.mesh = mesh
         self.map_fn = map_fn
         self.config = config
@@ -521,7 +553,9 @@ class DeviceEngine:
                         kk, vv, pp, mm, Tc, cfg.reduce_op,
                         unit_values=cfg.unit_values,
                         rank_sort=cfg.rank_sort,
-                        sort_impl=cfg.sort_impl),
+                        sort_impl=cfg.sort_impl,
+                        segment_impl=cfg.segment_impl,
+                        segment_block=cfg.segment_block),
                     keys0, vals0, pay0, valid0)
                 v_shape, v_dtype = cu0.values.shape[1:], cu0.values.dtype
             else:
@@ -556,7 +590,9 @@ class DeviceEngine:
                         keys, vals, pay, valid, Tc, cfg.reduce_op,
                         unit_values=cfg.unit_values,
                         rank_sort=cfg.rank_sort,
-                        sort_impl=cfg.sort_impl)
+                        sort_impl=cfg.sort_impl,
+                        segment_impl=cfg.segment_impl,
+                        segment_block=cfg.segment_block)
                     keys, vals, pay, valid = (cu.keys, cu.values,
                                               cu.payload, cu.valid)
                     comb_oflow = comb_oflow + jnp.maximum(
@@ -591,7 +627,9 @@ class DeviceEngine:
             local = sorted_unique_reduce(
                 buf_k, buf_v, buf_p, buf_valid, cfg.local_capacity,
                 local_op, unit_values=local_unit, rank_sort=cfg.rank_sort,
-                sort_impl=cfg.sort_impl)
+                sort_impl=cfg.sort_impl,
+                segment_impl=cfg.segment_impl,
+                segment_block=cfg.segment_block)
             local_oflow = (map_oflow + comb_oflow
                            + jnp.maximum(local.n_unique
                                          - cfg.local_capacity, 0))
@@ -612,7 +650,9 @@ class DeviceEngine:
             fin = sorted_unique_reduce(
                 ex.keys, ex.values, ex.payload, ex.valid, cfg.out_capacity,
                 fin_op, unit_values=False, rank_sort=cfg.rank_sort,
-                sort_impl=cfg.sort_impl)
+                sort_impl=cfg.sort_impl,
+                segment_impl=cfg.segment_impl,
+                segment_block=cfg.segment_block)
             fin_oflow = jnp.maximum(fin.n_unique - cfg.out_capacity, 0)
 
             # LOCAL overflow per device — the host sums across devices
@@ -1035,12 +1075,17 @@ class DeviceEngine:
         # the fused fold re-sorts the accumulator rows (out_capacity
         # running uniques) into every wave's final merge pass; the
         # argsort tier additionally pays the second sort pass and the
-        # permutation gathers (tier-0's runtime price)
+        # permutation gathers (tier-0's runtime price); segment_impl
+        # picks between the scan-ladder term and the fused-kernel term
+        # (one pass over the records instead of log2(N) ladder passes)
+        # so a pallas-served run's MFU/roofline gauges model the program
+        # that actually ran
         return _profile.analytic_costs(input_bytes, n_records,
                                        record_bytes,
                                        fold_records=cfg.out_capacity,
                                        argsort=(cfg.sort_impl
-                                                == "argsort"))
+                                                == "argsort"),
+                                       segment_impl=cfg.segment_impl)
 
     def precompile(self, row_shape, row_dtype=np.uint8,
                    k: int = None) -> float:

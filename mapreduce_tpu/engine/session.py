@@ -561,8 +561,9 @@ class EngineSession:
         refresh_stream_age_gauges()
         return DeviceResult(keys_h, vals_h, pay_h, valid_h, overflow)
 
-    def stats(self, task: Optional[str] = None) -> Dict[str, int]:
-        """Stream counters (chunks/waves/feeds/overflow) for *task*."""
+    def stats(self, task: Optional[str] = None) -> Dict[str, object]:
+        """Stream counters (chunks/waves/feeds/overflow) for *task*,
+        plus the serving kernel formulations when not the lax default."""
         task = self.default_task if task is None else str(task)
         with self._lock:
             st = self._streams.get(task)
@@ -574,6 +575,15 @@ class EngineSession:
                 # only partition_map streams can rebalance; embedders
                 # without the feature see exactly the pre-control keys
                 out["rebalances"] = st.rebalances
+            if (self.config.segment_impl != "lax"
+                    or self.config.tokenize_impl != "lax"):
+                # kernel-served sessions say so (the Pallas hot path is
+                # a formulation switch, bit-identical by contract, but
+                # an operator reading serving stats should see which
+                # program family is resident); lax sessions keep the
+                # pre-kernel key set exactly
+                out["segment_impl"] = self.config.segment_impl
+                out["tokenize_impl"] = self.config.tokenize_impl
             return out
 
     # -- skew-aware repartition (engine/autotune.RepartitionController) ----

@@ -44,7 +44,8 @@ def _wordcount_map_fn(chunk, chunk_index, cfg: EngineConfig):
     import jax.numpy as jnp
 
     L = chunk.shape[0]
-    toks = tokenize_hash(chunk)
+    toks = tokenize_hash(chunk, impl=cfg.tokenize_impl,
+                         block=cfg.tokenize_block)
     gstart = chunk_index * L + toks.start  # global byte offset, fits i32
     tc = tile_compact(toks.is_end, cfg.tile, cfg.tile_records,
                       toks.keys[:, 0], toks.keys[:, 1], gstart)
@@ -79,7 +80,8 @@ def _wordcount_map_fn_verify(chunk, chunk_index, cfg: EngineConfig):
     import jax.numpy as jnp
 
     L = chunk.shape[0]
-    toks = tokenize_hash(chunk, multipliers=(HASH_A1, HASH_A2, HASH_A3))
+    toks = tokenize_hash(chunk, multipliers=(HASH_A1, HASH_A2, HASH_A3),
+                         impl=cfg.tokenize_impl, block=cfg.tokenize_block)
     gstart = chunk_index * L + toks.start
     tc = tile_compact(toks.is_end, cfg.tile, cfg.tile_records,
                       toks.keys[:, 0], toks.keys[:, 1],
@@ -102,13 +104,20 @@ def bench_engine_config() -> EngineConfig:
     ~850K running words but well under 100K uniques), so the in-scan
     combiner shrinks the device-wide sort ~4x; combine_capacity 1<<17
     (~131K slots per chunk) clears any natural-language vocabulary with
-    headroom while keeping the wave program shape fixed."""
+    headroom while keeping the wave program shape fixed.
+    segment_impl/tokenize_impl 'pallas': the flagship bench serves the
+    fused hot-path kernels (ops/segscan, ops/tokenize) — bit-identical
+    to the lax formulations (golden suite + the bench's own pallas
+    smoke gate), selected here so `europarl_wordcount_compute_s` and
+    the gated `wordcount_mfu` key measure the kernel-served program."""
     return EngineConfig(local_capacity=1 << 18,
                         exchange_capacity=1 << 17,
                         out_capacity=1 << 18,
                         tile=512, tile_records=104,
                         combine_in_scan=True,
-                        combine_capacity=1 << 17)
+                        combine_capacity=1 << 17,
+                        segment_impl="pallas",
+                        tokenize_impl="pallas")
 
 
 class DeviceWordCount:

@@ -85,7 +85,12 @@ def device_config():
         # 'tiered' serves a cold machine on the fast-compiling argsort
         # tier while the variadic program builds in the background
         # (cli wordcount --device --sort-impl)
-        sort_impl=str(_conf.get("device_sort_impl", "variadic")))
+        sort_impl=str(_conf.get("device_sort_impl", "variadic")),
+        # the Pallas hot-path kernels (cli wordcount --device
+        # --segment-impl/--tokenize-impl): bit-identical formulation
+        # switches, so results never depend on them
+        segment_impl=str(_conf.get("device_segment_impl", "lax")),
+        tokenize_impl=str(_conf.get("device_tokenize_impl", "lax")))
 
 
 def device_prepare(pairs, mesh):

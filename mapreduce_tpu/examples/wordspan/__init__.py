@@ -148,7 +148,8 @@ def device_map(chunk, chunk_index, cfg):
     from ...ops.tokenize import tokenize_hash
 
     L = chunk.shape[0]
-    toks = tokenize_hash(chunk)
+    toks = tokenize_hash(chunk, impl=cfg.tokenize_impl,
+                         block=cfg.tokenize_block)
     gstart = chunk_index * L + toks.start
     tc = tile_compact(toks.is_end, cfg.tile, cfg.tile_records,
                       toks.keys[:, 0], toks.keys[:, 1], gstart)

@@ -132,41 +132,69 @@ _SEGSCAN_FLOPS = 32
 #: sort ladder of the [key, perm] pair plus a full-record permutation
 #: gather per stage (index arithmetic; the traffic is in the bytes term)
 _GATHER_FLOPS = 4
+#: the fused Pallas segmented-reduce kernel's per-record work (boundary
+#: compares + one combine + the end-count add, in ONE pass) — the
+#: kernel-formulation twin of _SEGSCAN_FLOPS, so a pallas-served run's
+#: roofline models the program that actually ran (ops/segscan kernel)
+_SEGREDUCE_KERNEL_FLOPS = 12
+#: scan-ladder HBM passes per record the LAX segmented-reduce pays
+#: beyond the sort (segmented_scan + ladder_cumsum, each log2(N) full
+#: read+write passes — modelled as this flat factor on the record
+#: buffer) vs the kernel's single read+write pass
+_SEGSCAN_LAX_BYTE_PASSES = 8
+_SEGREDUCE_KERNEL_BYTE_PASSES = 1
 
 
 def analytic_costs(input_bytes: int, n_records: int,
                    record_bytes: int,
                    fold_records: int = 0,
-                   argsort: bool = False) -> Dict[str, float]:
+                   argsort: bool = False,
+                   segment_impl: str = "lax") -> Dict[str, float]:
     """Rough cost of one engine wave when XLA's model is unavailable:
     the program is sort-dominated (device_engine.py module doc), so
-    FLOPs ≈ records × log2(records) compare-exchanges + a linear
-    segscan term, and bytes ≈ the input read plus one read+write of the
-    record buffer per sort pass.  ``fold_records`` accounts for the
-    fused wave fold — the accumulator rows (``out_capacity`` running
-    uniques) re-sorted into the final per-partition merge every wave,
-    which the single-dispatch program pays in place of the old separate
-    merge dispatch.  With ``argsort`` (the tier-0 serving program) each
-    sort site pays a SECOND stable 1-key pass over the ``[key, perm]``
-    pair plus a full-record permutation gather — the runtime price of
-    the fast-compiling formulation (measured ~2.6x end to end at bench
+    FLOPs ≈ records × log2(records) compare-exchanges + a
+    segmented-reduce term, and bytes ≈ the input read plus one
+    read+write of the record buffer per sort pass plus the
+    segmented-reduce passes.  ``fold_records`` accounts for the fused
+    wave fold — the accumulator rows (``out_capacity`` running uniques)
+    re-sorted into the final per-partition merge every wave, which the
+    single-dispatch program pays in place of the old separate merge
+    dispatch.  With ``argsort`` (the tier-0 serving program) each sort
+    site pays a SECOND stable 1-key pass over the ``[key, perm]`` pair
+    plus a full-record permutation gather — the runtime price of the
+    fast-compiling formulation (measured ~2.6x end to end at bench
     shapes), modelled so a run served on tier-0 doesn't report tier-1's
-    cheaper roofline.  An estimate with the right shape and order of
-    magnitude — labelled ``source="analytic"`` everywhere it lands so
-    nobody mistakes it for a measurement."""
+    cheaper roofline.  ``segment_impl`` picks the segmented-reduce
+    formulation the same way (the PR-12 argsort-term pattern):
+    ``"lax"`` models the ladder chain (shifted compares +
+    segmented_scan + ladder_cumsum — several full read+write passes
+    over the sorted records), ``"pallas"`` the fused kernel's single
+    VMEM-tiled pass, so MFU/roofline gauges and the ``cost_analysis``
+    fallback agree on which program actually ran.  An estimate with
+    the right shape and order of magnitude — labelled
+    ``source="analytic"`` everywhere it lands so nobody mistakes it
+    for a measurement."""
     import math
 
+    if segment_impl == "pallas":
+        seg_flops = _SEGREDUCE_KERNEL_FLOPS
+        seg_byte_passes = _SEGREDUCE_KERNEL_BYTE_PASSES
+    else:
+        seg_flops = _SEGSCAN_FLOPS
+        seg_byte_passes = _SEGSCAN_LAX_BYTE_PASSES
     n = max(int(n_records), 1)
     passes = max(int(math.ceil(math.log2(n))), 1)
-    flops = float(n * passes * _SORT_CMP_FLOPS + n * _SEGSCAN_FLOPS)
+    flops = float(n * passes * _SORT_CMP_FLOPS + n * seg_flops)
     nbytes = float(max(int(input_bytes), 0)
-                   + 2 * n * max(int(record_bytes), 1) * passes)
+                   + 2 * n * max(int(record_bytes), 1) * passes
+                   + 2 * n * max(int(record_bytes), 1) * seg_byte_passes)
     if fold_records > 0:
         m = int(fold_records)
         fold_passes = max(int(math.ceil(math.log2(m))), 1)
         flops += float(m * fold_passes * _SORT_CMP_FLOPS
-                       + m * _SEGSCAN_FLOPS)
-        nbytes += float(2 * m * max(int(record_bytes), 1) * fold_passes)
+                       + m * seg_flops)
+        nbytes += float(2 * m * max(int(record_bytes), 1)
+                        * (fold_passes + seg_byte_passes))
     if argsort:
         # second sort ladder (the [key, perm] pair: ~12B/row) + one
         # permutation gather of every record lane, per sorted batch

@@ -10,7 +10,9 @@ everything jit/shard_map-compatible (SURVEY.md §7 hard part (a)).
 """
 
 from .compaction import tile_compact  # noqa: F401
+from .pallas_compat import default_interpret, pick_block  # noqa: F401
 from .segscan import (  # noqa: F401
-    SENTINEL, ladder_cummax, ladder_cumsum, segmented_scan,
-    sorted_unique_reduce)
-from .tokenize import tokenize_hash, WORD_HASH_LANES  # noqa: F401
+    SEGMENT_BLOCK, SENTINEL, ladder_cummax, ladder_cumsum,
+    segmented_scan, sorted_unique_reduce)
+from .tokenize import (  # noqa: F401
+    TOKENIZE_BLOCK, WORD_HASH_LANES, tokenize_hash)

@@ -45,42 +45,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import default_interpret, pallas_call, pick_block, sds
+
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
+
+#: shared plumbing lives in ops/pallas_compat (ONE spelling of the
+#: CPU-fallback policy across every kernel module); the old private
+#: names stay as aliases for in-tree callers of the kernel internals
+_pick_block = pick_block
+_sds = sds
 
 
 def _on_diag(iq, j, block_q, block_kv):
     """Does KV tile j intersect or precede Q tile iq's causal row range?"""
     return j * block_kv <= iq * block_q + block_q - 1
-
-
-def _pick_block(t: int, want: int) -> int:
-    """Largest block <= *want* that divides *t* and satisfies Mosaic's
-    sublane rule (multiple of 8, or the whole dimension).  Falls back to
-    the smallest valid divisor above *want* (worst case t itself, one
-    VMEM-resident tile) so ANY sequence length works — a T=640 config
-    that trained on the jnp path must not start raising here."""
-    if t <= want:
-        return t
-    for b in range(want, 7, -1):
-        if t % b == 0 and b % 8 == 0:
-            return b
-    for b in range(want + 1, t):
-        if t % b == 0 and (b % 8 == 0 or b == t):
-            return b
-    return t
-
-
-def _sds(shape, dtype, like):
-    """ShapeDtypeStruct inheriting *like*'s varying-mesh-axes set, so the
-    kernel composes with shard_map's vma checking (the kernel is purely
-    per-device: outputs vary exactly as its inputs do)."""
-    try:
-        vma = jax.typeof(like).vma
-    except AttributeError:  # pragma: no cover - older jax
-        vma = None
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 # -- forward -----------------------------------------------------------------
@@ -310,8 +288,9 @@ def _fwd_call(q, k, v, cfgt):
     kernel = functools.partial(
         _fwd_kernel, causal=causal,
         block_q=block_q, block_kv=block_kv, n_kv=n_kv)
-    out, lse = pl.pallas_call(
+    out, lse = pallas_call(
         kernel,
+        name="flash_fwd",
         grid=(B, H, n_q, n_kv),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[q_spec, row_spec],
@@ -346,9 +325,10 @@ def _bwd_call(q, k, v, out, lse, do, cfgt, dlse=None):
     kv_spec = pl.BlockSpec((1, 1, block_kv, D), kv_index)
     row_spec = pl.BlockSpec((1, 1, block_q, 1), _q_index)
 
-    dq = pl.pallas_call(
+    dq = pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv, n_kv=n_kv),
+        name="flash_dq",
         grid=(B, H, n_q, n_kv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -372,9 +352,10 @@ def _bwd_call(q, k, v, out, lse, do, cfgt, dlse=None):
     q_spec2 = pl.BlockSpec((1, 1, block_q, D), q_index2)
     kv_spec2 = pl.BlockSpec((1, 1, block_kv, D), kv_index2)
     row_spec2 = pl.BlockSpec((1, 1, block_q, 1), q_index2)
-    dk, dv = pl.pallas_call(
+    dk, dv = pallas_call(
         functools.partial(_dkv_kernel, causal=causal,
                           block_q=block_q, block_kv=block_kv, n_q=n_q),
+        name="flash_dkv",
         grid=(B, H, n_kv, n_q),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
                   row_spec2],
@@ -416,12 +397,10 @@ def _make_cfgt(q, k, causal, scale, block_q, block_kv, interpret):
     D = q.shape[3]
     if scale is None:
         scale = D ** -0.5
-    block_q = _pick_block(q.shape[2], block_q)
-    block_kv = _pick_block(k.shape[2], block_kv)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    block_q = pick_block(q.shape[2], block_q)
+    block_kv = pick_block(k.shape[2], block_kv)
     return (bool(causal), float(scale), int(block_q), int(block_kv),
-            bool(interpret))
+            default_interpret(interpret))
 
 
 def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,

@@ -1,0 +1,110 @@
+"""Shared Pallas plumbing: ONE spelling of the CPU-fallback policy.
+
+Every Pallas kernel in this repo (ops/flash_attention.py and the fused
+wave-program hot-path kernels in ops/segscan.py / ops/tokenize.py) wants
+the same three pieces of glue, previously duplicated inside
+flash_attention:
+
+* **interpret-mode default** — ``interpret = jax.default_backend() !=
+  "tpu"``: compiled Mosaic on a real TPU, the Pallas interpreter
+  everywhere else, so the tier-1 CPU test mesh executes the REAL kernel
+  logic (grid sequencing, scratch carries, block index maps) rather
+  than a shadow jnp implementation.  Interpret-mode numbers validate
+  semantics, never speed.
+* **block-size fitting** — :func:`pick_block` shrinks a requested block
+  to one that divides the dimension and satisfies Mosaic's sublane
+  rule, so ANY shape works without the caller raising.
+* **vma-aware out shapes** — :func:`sds` builds ShapeDtypeStructs that
+  inherit an exemplar's varying-mesh-axes set, so a kernel composes
+  with ``shard_map``'s vma checking (the kernels are purely per-device:
+  outputs vary exactly as their inputs do).
+
+:func:`pallas_call` is the thin entry point the kernel modules dispatch
+through: it resolves the interpret default in ONE place, forwards an
+optional ``pl.CostEstimate`` hint, and counts kernel-program traces in
+the metrics registry (``mrtpu_pallas_kernel_builds_total``).  The count
+is TRACE-time: compiles and abstract shape probes (the engine's
+``jax.eval_shape`` aval derivations reach here too) both increment it,
+while warm executable-cache dispatches add nothing — so a nonzero delta
+is the registry witness that a config actually routes through the
+kernel programs (what the bench smoke asserts), not a count of XLA
+kernel compiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+
+from ..obs import metrics as _obs
+
+# NOTE: jax.experimental.pallas is imported lazily inside
+# :func:`pallas_call` — this module rides every package import (ops/
+# __init__), and the suite spawns many short-lived subprocesses that
+# never build a kernel; they should not pay the pallas import.
+
+_KERNEL_BUILDS = _obs.counter(
+    "mrtpu_pallas_kernel_builds_total",
+    "Pallas kernel programs traced (labels: kernel, "
+    "mode=interpret|mosaic) — a trace-time count: incremented whenever "
+    "an enclosing program traces the kernel (compiles AND abstract "
+    "shape probes like the engine's eval_shape aval derivations), zero "
+    "on warm executable-cache dispatches.  A nonzero delta therefore "
+    "witnesses 'this config routes through the kernel', not 'XLA "
+    "compiled N kernels'")
+
+
+def default_interpret(interpret: Optional[bool] = None) -> bool:
+    """THE interpret-mode policy: compiled Mosaic on TPU, the Pallas
+    interpreter everywhere else (``None`` = auto).  An explicit bool
+    wins — tests force either mode deterministically."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def pick_block(t: int, want: int) -> int:
+    """Largest block <= *want* that divides *t* and satisfies Mosaic's
+    sublane rule (multiple of 8, or the whole dimension).  Falls back to
+    the smallest valid divisor above *want* (worst case *t* itself, one
+    VMEM-resident tile) so ANY dimension works — a shape that ran on the
+    jnp path must not start raising here."""
+    if t <= want:
+        return t
+    for b in range(want, 7, -1):
+        if t % b == 0 and b % 8 == 0:
+            return b
+    for b in range(want + 1, t):
+        if t % b == 0 and (b % 8 == 0 or b == t):
+            return b
+    return t
+
+
+def sds(shape: Sequence[int], dtype: Any, like: Any) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct inheriting *like*'s varying-mesh-axes set, so the
+    kernel composes with shard_map's vma checking (the kernel is purely
+    per-device: outputs vary exactly as its inputs do)."""
+    try:
+        vma = jax.typeof(like).vma
+    except AttributeError:  # pragma: no cover - older jax
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(tuple(shape), dtype, vma=vma)
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def pallas_call(kernel, *, name: str, interpret: Optional[bool] = None,
+                cost_estimate: Optional[Any] = None, **kwargs):
+    """``pl.pallas_call`` with the repo-wide CPU-fallback policy applied
+    and the build counted (*name* labels the kernel family in
+    ``mrtpu_pallas_kernel_builds_total``).  *cost_estimate* forwards a
+    ``pl.CostEstimate`` scheduling hint when the caller has one."""
+    from jax.experimental import pallas as pl  # lazy: see module note
+
+    interp = default_interpret(interpret)
+    _KERNEL_BUILDS.inc(kernel=name,
+                       mode="interpret" if interp else "mosaic")
+    if cost_estimate is not None:
+        kwargs["cost_estimate"] = cost_estimate
+    return pl.pallas_call(kernel, name=name, interpret=interp, **kwargs)

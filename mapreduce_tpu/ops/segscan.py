@@ -17,20 +17,44 @@ measured in round 1).
 is what lets the device path accept any user monoid, not just
 {sum,min,max} (the compiler-visible form of the reference's
 associative/commutative/idempotent reducer flags, reducefn.lua:10-14).
+
+The post-sort stage also has a fused Pallas formulation
+(``segment_impl='pallas'``, the ``_segreduce_kernel`` below): boundary
+detection + segmented combine + run-end count in ONE VMEM-tiled pass
+instead of the ladders' log2(N) full-array passes, bit-identical for
+the engine's integer monoids and pinned by tests/test_pallas_ops.py.
 """
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Sequence, Tuple
+import functools
+from typing import Callable, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from . import pallas_compat
+
+# jax.experimental.pallas is imported lazily inside the kernel/wrapper
+# functions: this module rides every engine import, and processes that
+# never select segment_impl='pallas' should not pay the pallas import
 
 #: sentinel key lane value marking invalid rows (sorts to the end);
 #: real keys equal to the sentinel pair are remapped to (0, 0) — here and
 #: at record-buffer build time (device_engine step) — so
 #: (SENTINEL, SENTINEL) is unambiguous.
 SENTINEL = jnp.uint32(0xFFFFFFFF)
+#: plain-int twin for Pallas kernel bodies (a module-level jnp constant
+#: would be a captured traced array, which pallas_call refuses)
+_SENT = np.uint32(0xFFFFFFFF)
+
+#: lane width of the fused segmented-reduce kernel's 2-D layout (the
+#: flattened record order is row-major over [rows, _SEG_LANES])
+_SEG_LANES = 128
+#: default elements per VMEM-tiled kernel block (multiple of _SEG_LANES;
+#: EngineConfig.segment_block overrides and fingerprints it)
+SEGMENT_BLOCK = 4096
 
 
 def _shift_right(x: jax.Array, d: int, fill) -> jax.Array:
@@ -93,6 +117,271 @@ def segmented_scan(op: Callable, starts: jax.Array,
     return v
 
 
+# -- the fused Pallas segmented-reduce kernel (segment_impl='pallas') --------
+#
+# One VMEM-tiled pass over the sorted lanes replaces the lax ladder
+# chain: run-boundary detection (shifted key compares, the previous/next
+# element carried across blocks), the segmented combine (or run-length
+# count), and the run-end cumulative count all happen per block, with
+# the cross-block state — last key, running combine value, running end
+# count — in kernel scratch that persists across the sequential grid.
+# The lax formulation pays log2(N) full-array HBM passes per ladder
+# (segmented_scan + ladder_cumsum + ladder_cummax); the kernel reads and
+# writes each record once.  Bit-identity to the lax path holds for any
+# integer monoid (the engine's contract): integer ops are associative in
+# machine arithmetic, so the kernel's two-level association order
+# produces identical bits, and the boundary/count lanes are exact by
+# construction (the golden suite pins it, ops- and engine-level).
+
+
+def _seg_ladder(flags: jax.Array, v: jax.Array, op: Callable):
+    """Within-row inclusive segmented scan along axis 1 of ``v`` ([R, L]
+    or [R, L, D]; *flags* [R, L]).  Returns ``(seen, v)``: ``seen[r, l]``
+    = a flag exists in row r at or before lane l, ``v[r, l]`` = op-fold
+    of row r from max(last flag, row start) through l.  Classic
+    Hillis-Steele with a POSITIONAL guard (lanes < d are already
+    complete) so unflagged row starts stay exact without an op
+    identity."""
+    lanes = flags.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, flags.shape, 1)
+    stacked = v.ndim == 3
+
+    def bsel(mask, a, b):
+        return jnp.where(mask[..., None] if stacked else mask, a, b)
+
+    f = flags
+    seen = flags
+    d = 1
+    while d < lanes:
+        f_l = jnp.concatenate(
+            [jnp.ones(f.shape[:1] + (d,), bool), f[:, :-d]], axis=1)
+        v_l = jnp.concatenate([v[:, :d], v[:, :-d]], axis=1)
+        v = bsel(f | (lane < d), v, op(v_l, v))
+        f = f | f_l
+        seen = seen | jnp.concatenate(
+            [jnp.zeros(seen.shape[:1] + (d,), bool), seen[:, :-d]], axis=1)
+        d *= 2
+    return seen, v
+
+
+def _shift1_flat(x: jax.Array, carry) -> jax.Array:
+    """*x* ([R, L]) shifted right by one in flattened row-major order;
+    *carry* (the previous block's last element) fills position [0, 0]."""
+    prev_last = jnp.concatenate(
+        [jnp.full((1, 1), carry, x.dtype), x[:-1, -1:]], axis=0)
+    return jnp.concatenate([prev_last, x[:, :-1]], axis=1)
+
+
+def _cumsum_2level(e: jax.Array, carry) -> jax.Array:
+    """Inclusive int32 cumsum of ``e`` ([R, L]) in flattened order,
+    seeded by *carry* (zeros fill = exact identity)."""
+    R, L = e.shape
+    d = 1
+    while d < L:
+        e = e + jnp.concatenate(
+            [jnp.zeros((R, d), jnp.int32), e[:, :-d]], axis=1)
+        d *= 2
+    rt = e[:, -1]
+    d = 1
+    while d < R:
+        rt = rt + jnp.concatenate([jnp.zeros((d,), jnp.int32), rt[:-d]])
+        d *= 2
+    prefix = jnp.concatenate([jnp.zeros((1,), jnp.int32), rt[:-1]]) + carry
+    return e + prefix[:, None]
+
+
+def _segreduce_kernel(k1_ref, k2_ref, nk1_ref, nk2_ref, *refs,
+                      op: Callable, n_lanes: int, unit: bool, R: int):
+    """One grid step = one [R, _SEG_LANES] block of the sorted lanes.
+    refs layout: n_lanes value in-refs (none when *unit*), then n_out
+    reduced out-refs (1 when *unit*), csum out-ref, then scratch:
+    carry keys (SMEM [2] u32), carry value (VMEM [1, n_out] value
+    dtype), carry end-count (SMEM [1] i32)."""
+    from jax.experimental import pallas as pl
+
+    n_out = 1 if unit else n_lanes
+    val_refs = () if unit else refs[:n_lanes]
+    red_refs = refs[0 if unit else n_lanes:][:n_out]
+    csum_ref = refs[(0 if unit else n_lanes) + n_out]
+    ck_ref, cv_ref, cc_ref = refs[-3:]
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _init():
+        ck_ref[0] = _SENT
+        ck_ref[1] = _SENT
+        cv_ref[...] = jnp.zeros_like(cv_ref)
+        cc_ref[0] = jnp.int32(0)
+
+    k1 = k1_ref[...]
+    k2 = k2_ref[...]
+    valid = jnp.logical_not((k1 == _SENT) & (k2 == _SENT))
+    pk1 = _shift1_flat(k1, ck_ref[0])
+    pk2 = _shift1_flat(k2, ck_ref[1])
+    is_start = valid & ((k1 != pk1) | (k2 != pk2))
+    nk1 = nk1_ref[...]
+    nk2 = nk2_ref[...]
+    nvalid = jnp.logical_not((nk1 == _SENT) & (nk2 == _SENT))
+    is_end = valid & ((k1 != nk1) | (k2 != nk2)
+                      | jnp.logical_not(nvalid))
+
+    if unit:
+        v = jnp.ones(k1.shape, jnp.int32)
+        op_eff = jnp.add
+        stacked = False
+    else:
+        lanes = [r[...] for r in val_refs]
+        stacked = n_lanes > 1
+        v = jnp.stack(lanes, axis=-1) if stacked else lanes[0]
+        op_eff = op
+    seen, v = _seg_ladder(is_start, v, op_eff)
+    # compose rows + the block carry: the within-row scan's last lane is
+    # each row's (flag, value) summary; an exclusive prefix of those
+    # summaries under the same segmented monoid — seeded by the carry
+    # value in scratch — gives every row the value of the run continuing
+    # into it from before
+    rf = jnp.any(is_start, axis=1)
+    rv = v[:, -1]                       # [R] or [R, D]
+    r_seen, r_inc = _seg_ladder(rf[None, :],
+                                rv[None, ...], op_eff)
+    r_seen, r_inc = r_seen[0], r_inc[0]
+    if stacked:
+        carry_v = cv_ref[0, :]          # [D]
+        comb = jnp.where(r_seen[:, None], r_inc,
+                         op_eff(jnp.broadcast_to(carry_v, r_inc.shape),
+                                r_inc))
+        pv = jnp.concatenate([carry_v[None, :].astype(v.dtype),
+                              comb[:-1]], axis=0)
+        final = jnp.where(seen[..., None], v,
+                          op_eff(jnp.broadcast_to(pv[:, None, :], v.shape),
+                                 v))
+        for i in range(n_out):
+            red_refs[i][...] = final[..., i]
+        cv_ref[0, :] = final[R - 1, _SEG_LANES - 1, :]
+    else:
+        carry_v = cv_ref[0, 0]
+        comb = jnp.where(r_seen, r_inc,
+                         op_eff(jnp.broadcast_to(carry_v, r_inc.shape),
+                                r_inc))
+        pv = jnp.concatenate(
+            [jnp.broadcast_to(carry_v, (1,)).astype(v.dtype), comb[:-1]])
+        final = jnp.where(seen, v,
+                          op_eff(jnp.broadcast_to(pv[:, None], v.shape),
+                                 v))
+        red_refs[0][...] = final
+        cv_ref[0, 0] = final[R - 1, _SEG_LANES - 1]
+
+    csum = _cumsum_2level(is_end.astype(jnp.int32), cc_ref[0])
+    csum_ref[...] = csum
+    ck_ref[0] = k1[R - 1, _SEG_LANES - 1]
+    ck_ref[1] = k2[R - 1, _SEG_LANES - 1]
+    cc_ref[0] = csum[R - 1, _SEG_LANES - 1]
+
+
+def _segment_reduce_pallas(k1s: jax.Array, k2s: jax.Array,
+                           vals_s: Sequence[jax.Array], op: Callable,
+                           unit_values: bool, block: int,
+                           interpret: Optional[bool]):
+    """The fused kernel path: returns ``(reduced_lanes, end_csum)`` over
+    the sorted key/value lanes, matching the lax formulation bit for bit
+    at every run-end position (the only rows the compaction gathers) and
+    in the end count everywhere."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N = k1s.shape[0]
+    L = _SEG_LANES
+    block = max(L, (int(block) // L) * L)
+    R = block // L
+    npad = -(-N // block) * block
+    pad = npad - N
+
+    def padded(x, fill):
+        if not pad:
+            return x
+        return jnp.concatenate(
+            [x, jnp.full((pad,) + x.shape[1:], fill, x.dtype)])
+
+    k1p = padded(k1s, SENTINEL)
+    k2p = padded(k2s, SENTINEL)
+    # next-element key lanes: ONE elementwise shift each (vs the lax
+    # ladders' log2(N) passes), SENTINEL-filled at the end so the last
+    # real row is a run end exactly as the lax path forces it
+    nk1 = jnp.concatenate([k1p[1:], jnp.full((1,), SENTINEL, jnp.uint32)])
+    nk2 = jnp.concatenate([k2p[1:], jnp.full((1,), SENTINEL, jnp.uint32)])
+    rows = npad // L
+    shape2 = (rows, L)
+    ins = [a.reshape(shape2) for a in (k1p, k2p, nk1, nk2)]
+    if unit_values:
+        n_lanes, n_out = 0, 1
+        out_dtype = jnp.int32
+    else:
+        n_lanes = n_out = len(vals_s)
+        # the scanned dtype the lax path would produce (a promoting
+        # custom monoid widens it); integer promotion is exact, so
+        # casting up front keeps bit-identity
+        probe = jax.eval_shape(
+            lambda a: op(a, a),
+            jax.ShapeDtypeStruct((2, 2) if n_lanes == 1 else
+                                 (2, 2, n_lanes), vals_s[0].dtype))
+        out_dtype = probe.dtype
+        ins += [padded(v, jnp.zeros((), v.dtype)).astype(out_dtype)
+                .reshape(shape2) for v in vals_s]
+    spec = pl.BlockSpec((R, L), lambda i: (i, 0))
+    outs = pallas_compat.pallas_call(
+        functools.partial(_segreduce_kernel, op=op, n_lanes=n_lanes,
+                          unit=unit_values, R=R),
+        name="segreduce",
+        interpret=interpret,
+        grid=(npad // block,),
+        in_specs=[spec] * len(ins),
+        out_specs=[spec] * (n_out + 1),
+        out_shape=[pallas_compat.sds(shape2, out_dtype, k1s)] * n_out
+        + [pallas_compat.sds(shape2, jnp.int32, k1s)],
+        scratch_shapes=[pltpu.SMEM((2,), jnp.uint32),
+                        pltpu.VMEM((1, max(n_out, 1)), out_dtype),
+                        pltpu.SMEM((1,), jnp.int32)],
+    )(*ins)
+    reduced = [o.reshape(-1)[:N] for o in outs[:n_out]]
+    end_csum = outs[n_out].reshape(-1)[:N]
+    return reduced, end_csum
+
+
+def _segment_reduce_lax(k1s: jax.Array, k2s: jax.Array,
+                        vals_s: Sequence[jax.Array], op: Callable,
+                        unit_values: bool):
+    """The ladder formulation (shifted compares + segmented_scan /
+    run-length cummax + ladder_cumsum) — the original reference path the
+    kernel is pinned bit-identical to."""
+    N = k1s.shape[0]
+    row_valid = ~((k1s == SENTINEL) & (k2s == SENTINEL))
+    prev1 = _shift_right(k1s, 1, 0)
+    prev2 = _shift_right(k2s, 1, 0)
+    is_start = row_valid & ((k1s != prev1) | (k2s != prev2))
+    # row 0 is always a segment head if valid (the shift fill of 0 would
+    # otherwise miss a genuine leading (0,0) key)
+    is_start = is_start.at[0].set(row_valid[0])
+    next1 = jnp.concatenate([k1s[1:], jnp.zeros((1,), jnp.uint32)])
+    next2 = jnp.concatenate([k2s[1:], jnp.zeros((1,), jnp.uint32)])
+    is_end = row_valid & ((k1s != next1) | (k2s != next2)
+                          | ~jnp.concatenate([row_valid[1:],
+                                              jnp.zeros((1,), bool)]))
+    is_end = is_end.at[-1].set(row_valid[-1])
+
+    idx = jnp.arange(N, dtype=jnp.int32)
+    if unit_values:
+        run_start = ladder_cummax(jnp.where(is_start, idx, jnp.int32(-1)))
+        reduced = [(idx - run_start + 1).astype(jnp.int32)]
+    else:
+        stacked = (jnp.stack(vals_s, axis=-1) if len(vals_s) > 1
+                   else vals_s[0])
+        scanned = segmented_scan(op, is_start, stacked)
+        reduced = ([scanned[..., i] for i in range(len(vals_s))]
+                   if len(vals_s) > 1 else [scanned])
+    end_csum = ladder_cumsum(is_end.astype(jnp.int32))
+    return reduced, end_csum
+
+
 class SortedUnique(NamedTuple):
     keys: jax.Array       # [capacity, 2] uint32, ascending among valid
     values: jax.Array     # [capacity, ...] run reductions
@@ -105,7 +394,10 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
                          valid: jax.Array, capacity: int,
                          op, unit_values: bool = False,
                          rank_sort: bool = True,
-                         sort_impl: str = "variadic") -> SortedUnique:
+                         sort_impl: str = "variadic",
+                         segment_impl: str = "lax",
+                         segment_block: int = SEGMENT_BLOCK,
+                         interpret: Optional[bool] = None) -> SortedUnique:
     """Group-by-key reduction for LARGE record batches: one sort, then
     shifted-compare run boundaries, a segmented scan (or run-length
     count when ``unit_values``), and gather-based compaction of the run
@@ -144,11 +436,30 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
       buckets on, at the cost of the extra permutation gathers
       (measured ~2.6x slower end to end at bench shapes, which is why
       it is a serving tier and not the steady state).
+
+    ``segment_impl`` picks the post-sort segmented-reduce formulation:
+
+    * ``"lax"`` (default) — the ladder chain above: shifted-compare
+      boundaries + segmented_scan / run-length cummax + ladder_cumsum,
+      each a log2(N)-pass Hillis-Steele over the full arrays;
+    * ``"pallas"`` — ONE fused VMEM-tiled kernel pass over the sorted
+      lanes (run-boundary detection, segmented combine or run-length
+      count, and the run-end cumulative count together, cross-block
+      state in kernel scratch), bit-identical to ``"lax"`` for the
+      engine's integer monoids (the golden suite pins it).  *
+      ``segment_block`` sets the kernel's elements-per-block tile;
+      ``interpret=None`` auto-selects the Pallas interpreter off-TPU
+      (ops/pallas_compat — CPU runs validate semantics, not speed).
+      The run-end compaction below is gather-based either way and is
+      shared verbatim between the two implementations.
     """
     if sort_impl not in ("variadic", "argsort"):
         raise ValueError(f"sort_impl must be 'variadic' or 'argsort' "
                          f"here, got {sort_impl!r} (the 'tiered' policy "
                          "is resolved by the engine before tracing)")
+    if segment_impl not in ("lax", "pallas"):
+        raise ValueError(f"segment_impl must be 'lax' or 'pallas', "
+                         f"got {segment_impl!r}")
     if isinstance(op, str):
         try:
             op = {"sum": jnp.add, "min": jnp.minimum,
@@ -199,33 +510,17 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
         vals_s = list(sorted_ops[2:2 + len(val_lanes)])
         pays_s = list(sorted_ops[2 + len(val_lanes):])
 
-    row_valid = ~((k1s == SENTINEL) & (k2s == SENTINEL))
-    prev1 = _shift_right(k1s, 1, 0)
-    prev2 = _shift_right(k2s, 1, 0)
-    is_start = row_valid & ((k1s != prev1) | (k2s != prev2))
-    # row 0 is always a segment head if valid (the shift fill of 0 would
-    # otherwise miss a genuine leading (0,0) key)
-    is_start = is_start.at[0].set(row_valid[0])
-    next1 = jnp.concatenate([k1s[1:], jnp.zeros((1,), jnp.uint32)])
-    next2 = jnp.concatenate([k2s[1:], jnp.zeros((1,), jnp.uint32)])
-    is_end = row_valid & ((k1s != next1) | (k2s != next2)
-                          | ~jnp.concatenate([row_valid[1:],
-                                              jnp.zeros((1,), bool)]))
-    is_end = is_end.at[-1].set(row_valid[-1])
-
-    idx = jnp.arange(N, dtype=jnp.int32)
-    if unit_values:
-        run_start = ladder_cummax(jnp.where(is_start, idx, jnp.int32(-1)))
-        reduced = [(idx - run_start + 1).astype(jnp.int32)]
+    if segment_impl == "pallas":
+        reduced, end_csum = _segment_reduce_pallas(
+            k1s, k2s, vals_s, op, unit_values, segment_block, interpret)
     else:
-        stacked = jnp.stack(vals_s, axis=-1) if len(vals_s) > 1 else vals_s[0]
-        scanned = segmented_scan(op, is_start, stacked)
-        reduced = ([scanned[:, i] for i in range(len(vals_s))]
-                   if len(vals_s) > 1 else [scanned])
+        reduced, end_csum = _segment_reduce_lax(
+            k1s, k2s, vals_s, op, unit_values)
 
     # compact run ends by GATHER: searchsorted over the cumulative end
-    # count finds the j-th run-end row (no O(N) scatter)
-    end_csum = ladder_cumsum(is_end.astype(jnp.int32))
+    # count finds the j-th run-end row (no O(N) scatter).  Shared
+    # verbatim between the two segment_impls, so the kernel's
+    # equivalence surface is exactly (reduced lanes, end_csum).
     n_unique = end_csum[-1] if N > 0 else jnp.int32(0)
     targets = jnp.arange(1, capacity + 1, dtype=jnp.int32)
     out_idx = jnp.searchsorted(end_csum, targets, side="left")

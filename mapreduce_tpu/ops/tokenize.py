@@ -36,11 +36,18 @@ mapfn.lua:4-7); multi-byte UTF-8 sequences are treated as word bytes.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+import functools
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from . import pallas_compat
+
+# jax.experimental.pallas is imported lazily inside the kernel/wrapper
+# functions: this module rides every engine import, and processes that
+# never select tokenize impl='pallas' should not pay the pallas import
 
 #: polynomial multipliers for the two 32-bit hash lanes (odd constants:
 #: FNV prime and a Murmur3 finalizer constant)
@@ -163,12 +170,164 @@ def _cummax_scan(x: jax.Array) -> jax.Array:
     return jnp.maximum(inner, prefix[:, None]).reshape(L)
 
 
+# -- the fused Pallas tokenizing map-scan (tokenize_impl='pallas') -----------
+#
+# tokenize_hash's lax formulation pays, per hash lane, a log2-pass
+# Hillis-Steele affine ladder over the full chunk, plus the boundary
+# cummax ladder — each pass a full HBM read+write of the chunk-sized
+# intermediates.  The kernel fuses byte classify + ALL affine-hash lanes
+# + the word-boundary cummax into ONE blocked pass: per [R, 128] VMEM
+# tile it composes the affine maps within-tile (two-level: lanes then
+# rows) and threads the cross-block state — previous byte's space-ness,
+# each hash lane's running value, the running word-start max — through
+# kernel scratch across the sequential grid.  uint32 affine composition
+# and int32 max are associative in machine arithmetic, so the result is
+# BIT-identical to the ladder formulation (the golden suite pins it
+# against the host oracle and the lax twin, including non-tile-multiple
+# chunk lengths).
+
+#: lane width of the tokenize kernel's 2-D layout
+_TOK_LANES = 128
+#: default bytes per kernel block (EngineConfig.tokenize_block
+#: overrides and fingerprints it)
+TOKENIZE_BLOCK = 4096
+_INT32_MIN = -(2 ** 31)
+
+
+def _tokenize_kernel(b_ref, nb_ref, *refs, multipliers: Tuple[int, ...],
+                     R: int):
+    """One grid step = one [R, _TOK_LANES] block of the byte chunk.
+    refs: per-multiplier hash out-refs, then end/start/length out-refs
+    (int32), then scratch: previous-byte space-ness (SMEM [1] i32),
+    per-lane running hash (SMEM [n_lanes] u32), running word-start max
+    (SMEM [1] i32)."""
+    from jax.experimental import pallas as pl
+
+    n_lanes = len(multipliers)
+    h_refs = refs[:n_lanes]
+    end_ref, start_ref, len_ref = refs[n_lanes:n_lanes + 3]
+    cps_ref, ch_ref, cs_ref = refs[n_lanes + 3:]
+    blk = pl.program_id(0)
+
+    @pl.when(blk == 0)
+    def _init():
+        cps_ref[0] = jnp.int32(1)   # "the byte before the chunk is a
+        for i in range(n_lanes):    # separator" (position 0 can start)
+            ch_ref[i] = jnp.uint32(0)
+        cs_ref[0] = jnp.int32(_INT32_MIN)
+
+    b = b_ref[...]                  # [R, L] uint8
+    space = _is_space(b)
+    word = jnp.logical_not(space)
+    next_space = _is_space(nb_ref[...])
+    is_end = word & next_space
+    # previous byte's space-ness, shifted in flattened order with the
+    # cross-block carry at [0, 0]
+    sp32 = space.astype(jnp.int32)
+    prev_last = jnp.concatenate(
+        [jnp.full((1, 1), cps_ref[0], jnp.int32), sp32[:-1, -1:]], axis=0)
+    prev_space = jnp.concatenate([prev_last, sp32[:, :-1]], axis=1) > 0
+    is_start = word & prev_space
+
+    # the within-tile scans ARE the module's lax ladders (_hillis_affine
+    # / _hillis_max): plain jnp code, identity-fill, exact — one
+    # spelling shared by both formulations so they cannot drift
+    L = b.shape[1]
+    b32 = b.astype(jnp.uint32)
+    for i, a in enumerate(multipliers):
+        m = jnp.where(word, jnp.uint32(a), jnp.uint32(0))
+        c = jnp.where(word, b32 + jnp.uint32(1), jnp.uint32(0))
+        mw, cw = _hillis_affine(m, c)
+        mi, ci = _hillis_affine(mw[None, :, -1], cw[None, :, -1])
+        mi, ci = mi[0], ci[0]           # inclusive row-total composition
+        hc = ch_ref[i]                  # running hash before this block
+        comb = hc * mi + ci             # carry ∘ rows 0..r, value lane
+        cp = jnp.concatenate(
+            [jnp.broadcast_to(hc, (1,)).astype(jnp.uint32), comb[:-1]])
+        h = cp[:, None] * mw + cw
+        h_refs[i][...] = h
+        ch_ref[i] = h[R - 1, L - 1]
+
+    pos = (jnp.int32(blk) * jnp.int32(R * L)
+           + jax.lax.broadcasted_iota(jnp.int32, (R, L), 0) * jnp.int32(L)
+           + jax.lax.broadcasted_iota(jnp.int32, (R, L), 1))
+    marks = jnp.where(is_start, pos, jnp.int32(-1))
+    mw = _hillis_max(marks)
+    rinc = _hillis_max(mw[None, :, -1])[0]
+    cmax = cs_ref[0]
+    pmax = jnp.concatenate(
+        [jnp.broadcast_to(cmax, (1,)).astype(jnp.int32),
+         jnp.maximum(rinc, cmax)[:-1]])
+    start = jnp.maximum(mw, pmax[:, None])
+    start_ref[...] = start
+    len_ref[...] = pos - start + jnp.int32(1)
+    end_ref[...] = is_end.astype(jnp.int32)
+    cps_ref[0] = sp32[R - 1, L - 1]
+    cs_ref[0] = start[R - 1, L - 1]
+
+
+def _tokenize_pallas(chunk: jax.Array, multipliers: Tuple[int, ...],
+                     block: int, interpret: Optional[bool]) -> TokenStream:
+    """The fused kernel path behind :func:`tokenize_hash`
+    (``impl='pallas'``) — identical TokenStream, one blocked pass."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N = chunk.shape[0]
+    L = _TOK_LANES
+    block = max(L, (int(block) // L) * L)
+    R = block // L
+    npad = -(-N // block) * block
+    pad = npad - N
+    cp = (jnp.concatenate([chunk, jnp.full((pad,), ord(" "), jnp.uint8)])
+          if pad else chunk)
+    # next byte, space-filled at the end (matching the lax path's
+    # next_space=True closure of the final word)
+    nb = jnp.concatenate([cp[1:], jnp.full((1,), ord(" "), jnp.uint8)])
+    rows = npad // L
+    shape2 = (rows, L)
+    spec = pl.BlockSpec((R, L), lambda i: (i, 0))
+    n_lanes = len(multipliers)
+    outs = pallas_compat.pallas_call(
+        functools.partial(_tokenize_kernel,
+                          multipliers=tuple(int(a) for a in multipliers),
+                          R=R),
+        name="tokenize",
+        interpret=interpret,
+        grid=(npad // block,),
+        in_specs=[spec, spec],
+        out_specs=[spec] * (n_lanes + 3),
+        out_shape=[pallas_compat.sds(shape2, jnp.uint32, chunk)] * n_lanes
+        + [pallas_compat.sds(shape2, jnp.int32, chunk)] * 3,
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32),
+                        pltpu.SMEM((n_lanes,), jnp.uint32),
+                        pltpu.SMEM((1,), jnp.int32)],
+    )(cp.reshape(shape2), nb.reshape(shape2))
+    keys = jnp.stack([o.reshape(-1)[:N] for o in outs[:n_lanes]], axis=-1)
+    end, start, length = (o.reshape(-1)[:N] for o in outs[n_lanes:])
+    return TokenStream(is_end=end.astype(bool), keys=keys,
+                       start=start, length=length)
+
+
 def tokenize_hash(chunk: jax.Array,
-                  multipliers=(HASH_A1, HASH_A2)) -> TokenStream:
+                  multipliers=(HASH_A1, HASH_A2),
+                  impl: str = "lax",
+                  block: int = TOKENIZE_BLOCK,
+                  interpret: Optional[bool] = None) -> TokenStream:
     """Tokenize one padded byte chunk ``[L] uint8`` entirely on-device.
 
     *multipliers* selects the polynomial hash lanes (one affine scan
-    each); collision-verify mode passes a third lane."""
+    each); collision-verify mode passes a third lane.  ``impl`` picks
+    the formulation: ``"lax"`` (the tiled Hillis-Steele ladders below)
+    or ``"pallas"`` (ONE fused blocked kernel pass — classify + all
+    hash lanes + boundary cummax together; bit-identical, pinned by the
+    golden suite).  *block*/*interpret* configure the kernel only."""
+    if impl not in ("lax", "pallas"):
+        raise ValueError(f"tokenize impl must be 'lax' or 'pallas', "
+                         f"got {impl!r}")
+    if impl == "pallas":
+        return _tokenize_pallas(chunk, tuple(multipliers), block,
+                                interpret)
     L = chunk.shape[0]
     b32 = chunk.astype(jnp.uint32)
     space = _is_space(chunk)

@@ -1,0 +1,186 @@
+"""Prototype: interpret-mode Pallas segmented-reduce + tokenize kernels."""
+import functools
+import numpy as np
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S = np.uint32(0xFFFFFFFF)
+L = 128
+
+
+def _shift1_flat(x, carry):
+    """x shifted right by one in flattened [R, L] order; carry fills [0,0]."""
+    lastcol = x[:, -1:]                       # [R, 1]
+    prevrow_last = jnp.concatenate(
+        [jnp.full((1, 1), carry, x.dtype), lastcol[:-1]], axis=0)  # [R, 1]
+    return jnp.concatenate([prevrow_last, x[:, :-1]], axis=1)
+
+
+def _seg_ladder_lanes(flags, v, op):
+    """Within-row inclusive segmented scan along the LAST axis: returns
+    (seen, v) where seen[r, l] = a flag exists in row r at or before l and
+    v[r, l] = op-fold of row r's elements from max(last flag, row start)
+    through l.  Classic Hillis-Steele with a positional guard so unflagged
+    row starts stay exact (no op-identity needed)."""
+    lanes = v.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, flags.shape, flags.ndim - 1)
+    f = flags
+    seen = flags
+    d = 1
+    while d < lanes:
+        f_l = jnp.concatenate(
+            [jnp.ones(f.shape[:-1] + (d,), bool), f[..., :-d]], axis=-1)
+        v_l = jnp.concatenate([v[..., :d], v[..., :-d]], axis=-1)
+        take = f | (lane < d)
+        v = jnp.where(take, v, op(v_l, v))
+        f = f | f_l
+        seen = seen | jnp.concatenate(
+            [jnp.zeros(seen.shape[:-1] + (d,), bool), seen[..., :-d]],
+            axis=-1)
+        d *= 2
+    return seen, v
+
+
+def _seg_kernel(k1_ref, k2_ref, nk1_ref, nk2_ref, v_ref,
+                red_ref, csum_ref, ck_ref, cv_ref, cc_ref, *, op, R):
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _init():
+        ck_ref[0] = S
+        ck_ref[1] = S
+        cv_ref[...] = jnp.zeros_like(cv_ref)
+        cc_ref[0] = jnp.int32(0)
+
+    k1 = k1_ref[...]
+    k2 = k2_ref[...]
+    valid = jnp.logical_not((k1 == S) & (k2 == S))
+    pk1 = _shift1_flat(k1, ck_ref[0])
+    pk2 = _shift1_flat(k2, ck_ref[1])
+    is_start = valid & ((k1 != pk1) | (k2 != pk2))
+    nk1 = nk1_ref[...]
+    nk2 = nk2_ref[...]
+    nvalid = jnp.logical_not((nk1 == S) & (nk2 == S))
+    is_end = valid & ((k1 != nk1) | (k2 != nk2) | jnp.logical_not(nvalid))
+
+    # within-row segmented scan, then compose rows + block carry
+    v = v_ref[...]  # [R, L]
+    seen, v = _seg_ladder_lanes(is_start, v, op)
+    rf = jnp.any(is_start, axis=-1)   # [R] row has a head
+    rv = v[:, -1]                      # [R] row fold (from last head)
+    rseen, rv_inc = _seg_ladder_lanes(rf[None, :], rv[None, :], op)
+    rseen, rv_inc = rseen[0], rv_inc[0]
+    carry_v = cv_ref[0, 0]
+    comb = jnp.where(rseen, rv_inc,
+                     op(jnp.broadcast_to(carry_v, rv_inc.shape), rv_inc))
+    pv = jnp.concatenate(
+        [jnp.broadcast_to(carry_v, (1,)).astype(rv.dtype), comb[:-1]])
+    final = jnp.where(seen, v,
+                      op(jnp.broadcast_to(pv[:, None], v.shape), v))
+    red_ref[...] = final
+
+    # plain cumsum of is_end in flattened order (+ block carry)
+    e = is_end.astype(jnp.int32)
+    d = 1
+    while d < L:
+        e = e + jnp.concatenate(
+            [jnp.zeros(e.shape[:-1] + (d,), jnp.int32), e[:, :-d]], axis=1)
+        d *= 2
+    rt = e[:, -1]
+    d = 1
+    while d < R:
+        rt = rt + jnp.concatenate([jnp.zeros((d,), jnp.int32), rt[:-d]])
+        d *= 2
+    pe = jnp.concatenate([jnp.zeros((1,), jnp.int32), rt[:-1]]) + cc_ref[0]
+    csum = e + pe[:, None]
+    csum_ref[...] = csum
+    # carries
+    ck_ref[0] = k1[R - 1, L - 1]
+    ck_ref[1] = k2[R - 1, L - 1]
+    cv_ref[0, 0] = final[R - 1, L - 1]
+    cc_ref[0] = csum[R - 1, L - 1]
+
+
+def seg_reduce_pallas(k1s, k2s, v, op, block=1024):
+    N = k1s.shape[0]
+    R = block // L
+    npad = -(-N // block) * block
+    pad = npad - N
+
+    def padded(x, fill):
+        return jnp.concatenate(
+            [x, jnp.full((pad,), fill, x.dtype)]) if pad else x
+
+    k1p = padded(k1s, S)
+    k2p = padded(k2s, S)
+    nk1 = jnp.concatenate([k1p[1:], jnp.full((1,), S, jnp.uint32)])
+    nk2 = jnp.concatenate([k2p[1:], jnp.full((1,), S, jnp.uint32)])
+    vp = padded(v, jnp.zeros((), v.dtype))
+    rows = npad // L
+    shape2 = (rows, L)
+    args = [a.reshape(shape2) for a in (k1p, k2p, nk1, nk2, vp)]
+    grid = (npad // block,)
+    spec = pl.BlockSpec((R, L), lambda i: (i, 0))
+    red, csum = pl.pallas_call(
+        functools.partial(_seg_kernel, op=op, R=R),
+        grid=grid,
+        in_specs=[spec] * 5,
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(shape2, v.dtype),
+                   jax.ShapeDtypeStruct(shape2, jnp.int32)],
+        scratch_shapes=[pltpu.SMEM((2,), jnp.uint32),
+                        pltpu.VMEM((1, 1), v.dtype),
+                        pltpu.SMEM((1,), jnp.int32)],
+        interpret=True,
+    )(*args)
+    return red.reshape(-1)[:N], csum.reshape(-1)[:N]
+
+
+def lax_reference(k1s, k2s, v, op):
+    import sys
+    sys.path.insert(0, "/root/repo")
+    from mapreduce_tpu.ops.segscan import segmented_scan, ladder_cumsum, _shift_right
+    row_valid = ~((k1s == S) & (k2s == S))
+    prev1 = _shift_right(k1s, 1, 0)
+    prev2 = _shift_right(k2s, 1, 0)
+    is_start = row_valid & ((k1s != prev1) | (k2s != prev2))
+    is_start = is_start.at[0].set(row_valid[0])
+    next1 = jnp.concatenate([k1s[1:], jnp.zeros((1,), jnp.uint32)])
+    next2 = jnp.concatenate([k2s[1:], jnp.zeros((1,), jnp.uint32)])
+    is_end = row_valid & ((k1s != next1) | (k2s != next2)
+                          | ~jnp.concatenate([row_valid[1:],
+                                              jnp.zeros((1,), bool)]))
+    is_end = is_end.at[-1].set(row_valid[-1])
+    scanned = segmented_scan(op, is_start, v)
+    csum = ladder_cumsum(is_end.astype(jnp.int32))
+    return scanned, csum, is_end
+
+
+rng = np.random.default_rng(0)
+for N in (1000, 4096, 5000, 1, 130, 2048):
+    keys = np.sort(rng.integers(0, max(N // 7, 2), size=N).astype(np.uint32))
+    k2 = (keys * 7 % 5).astype(np.uint32)
+    nvalid = rng.integers(0, max(N // 3, 1))
+    k1s = np.concatenate([keys[:N - nvalid],
+                          np.full(nvalid, 0xFFFFFFFF, np.uint32)])
+    k2s = np.concatenate([k2[:N - nvalid],
+                          np.full(nvalid, 0xFFFFFFFF, np.uint32)])
+    order = np.lexsort((k2s, k1s))
+    k1s, k2s = k1s[order], k2s[order]
+    v = rng.integers(0, 100, size=N).astype(np.int32)
+    for op, name in ((jnp.add, "sum"), (jnp.minimum, "min"),
+                     (jnp.maximum, "max")):
+        got_r, got_c = seg_reduce_pallas(jnp.asarray(k1s), jnp.asarray(k2s),
+                                         jnp.asarray(v), op)
+        exp_r, exp_c, is_end = lax_reference(
+            jnp.asarray(k1s), jnp.asarray(k2s), jnp.asarray(v), op)
+        ie = np.asarray(is_end)
+        assert np.array_equal(np.asarray(got_r)[ie],
+                              np.asarray(exp_r)[ie]), (N, name)
+        assert np.array_equal(np.asarray(got_c), np.asarray(exp_c)), (N, name)
+    print(f"N={N} OK  ends={ie.sum()}")
+print("seg kernel prototype OK")

@@ -1,0 +1,176 @@
+"""Prototype: interpret-mode Pallas tokenizing map-scan kernel."""
+import functools
+import numpy as np
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+L = 128
+INT_MIN = -(2 ** 31)
+_WS = (32, 9, 10, 13, 12, 11)
+
+
+def _is_space(b):
+    m = b == jnp.uint8(_WS[0])
+    for w in _WS[1:]:
+        m = m | (b == jnp.uint8(w))
+    return m
+
+
+def _affine_ladder_lanes(m, c):
+    lanes = m.shape[-1]
+    d = 1
+    while d < lanes:
+        m_l = jnp.concatenate(
+            [jnp.ones(m.shape[:-1] + (d,), m.dtype), m[..., :-d]], axis=-1)
+        c_l = jnp.concatenate(
+            [jnp.zeros(c.shape[:-1] + (d,), c.dtype), c[..., :-d]], axis=-1)
+        m, c = m * m_l, m * c_l + c
+        d *= 2
+    return m, c
+
+
+def _max_ladder_lanes(x):
+    lanes = x.shape[-1]
+    lowest = jnp.iinfo(x.dtype).min
+    d = 1
+    while d < lanes:
+        x = jnp.maximum(x, jnp.concatenate(
+            [jnp.full(x.shape[:-1] + (d,), lowest, x.dtype), x[..., :-d]],
+            axis=-1))
+        d *= 2
+    return x
+
+
+def _tok_kernel(b_ref, nb_ref, *refs, multipliers, R):
+    n_lanes = len(multipliers)
+    h_refs = refs[:n_lanes]
+    end_ref, start_ref, len_ref = refs[n_lanes:n_lanes + 3]
+    cps_ref, ch_ref, cs_ref = refs[n_lanes + 3:]
+    blk = pl.program_id(0)
+
+    @pl.when(blk == 0)
+    def _init():
+        cps_ref[0] = jnp.int32(1)   # "previous byte is a separator"
+        for i in range(n_lanes):
+            ch_ref[i] = jnp.uint32(0)
+        cs_ref[0] = jnp.int32(INT_MIN)
+
+    b = b_ref[...]                  # [R, L] uint8
+    nb = nb_ref[...]
+    space = _is_space(b)
+    word = jnp.logical_not(space)
+    next_space = _is_space(nb)
+    is_end = word & next_space
+    # prev_space shifted in flattened order, carry at [0, 0]
+    sp32 = space.astype(jnp.int32)
+    lastcol = jnp.concatenate(
+        [jnp.full((1, 1), cps_ref[0], jnp.int32), sp32[:-1, -1:]], axis=0)
+    prev_space = jnp.concatenate([lastcol, sp32[:, :-1]], axis=1) > 0
+    is_start = word & prev_space
+
+    b32 = b.astype(jnp.uint32)
+    for i, a in enumerate(multipliers):
+        m = jnp.where(word, jnp.uint32(a), jnp.uint32(0))
+        c = jnp.where(word, b32 + jnp.uint32(1), jnp.uint32(0))
+        mw, cw = _affine_ladder_lanes(m, c)
+        mr, cr = mw[:, -1], cw[:, -1]           # row totals
+        mi, ci = _affine_ladder_lanes(mr[None, :], cr[None, :])
+        mi, ci = mi[0], ci[0]
+        hc = ch_ref[i]
+        comb_c = hc * mi + ci                     # carry ∘ rows 0..r
+        cp = jnp.concatenate(
+            [jnp.broadcast_to(hc, (1,)).astype(jnp.uint32), comb_c[:-1]])
+        h = cp[:, None] * mw + cw
+        h_refs[i][...] = h
+        ch_ref[i] = h[R - 1, L - 1]
+
+    pos = (jnp.int32(blk) * jnp.int32(R * L)
+           + jax.lax.broadcasted_iota(jnp.int32, (R, L), 0) * jnp.int32(L)
+           + jax.lax.broadcasted_iota(jnp.int32, (R, L), 1))
+    marks = jnp.where(is_start, pos, jnp.int32(-1))
+    mw = _max_ladder_lanes(marks)
+    rmax = mw[:, -1]
+    rinc = _max_ladder_lanes(rmax[None, :])[0]
+    cmax = cs_ref[0]
+    pmax = jnp.concatenate(
+        [jnp.broadcast_to(cmax, (1,)).astype(jnp.int32),
+         jnp.maximum(rinc, cmax)[:-1]])
+    start = jnp.maximum(mw, pmax[:, None])
+    start_ref[...] = start
+    len_ref[...] = pos - start + jnp.int32(1)
+    end_ref[...] = is_end.astype(jnp.int32)
+    cps_ref[0] = sp32[R - 1, L - 1]
+    cs_ref[0] = start[R - 1, L - 1]
+
+
+def tokenize_pallas(chunk, multipliers=(16777619, 0x85EBCA6B), block=1024):
+    N = chunk.shape[0]
+    R = block // L
+    npad = -(-N // block) * block
+    pad = npad - N
+    cp = jnp.concatenate([chunk, jnp.full((pad,), 32, jnp.uint8)]) \
+        if pad else chunk
+    nb = jnp.concatenate([cp[1:], jnp.full((1,), 32, jnp.uint8)])
+    rows = npad // L
+    shape2 = (rows, L)
+    spec = pl.BlockSpec((R, L), lambda i: (i, 0))
+    n_lanes = len(multipliers)
+    outs = pl.pallas_call(
+        functools.partial(_tok_kernel, multipliers=tuple(multipliers), R=R),
+        grid=(npad // block,),
+        in_specs=[spec, spec],
+        out_specs=[spec] * (n_lanes + 3),
+        out_shape=[jax.ShapeDtypeStruct(shape2, jnp.uint32)] * n_lanes
+        + [jax.ShapeDtypeStruct(shape2, jnp.int32)] * 3,
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32),
+                        pltpu.SMEM((n_lanes,), jnp.uint32),
+                        pltpu.SMEM((1,), jnp.int32)],
+        interpret=True,
+    )(cp.reshape(shape2), nb.reshape(shape2))
+    hs = [o.reshape(-1)[:N] for o in outs[:n_lanes]]
+    end, start, length = (o.reshape(-1)[:N] for o in outs[n_lanes:])
+    return (end.astype(bool), jnp.stack(hs, axis=-1), start, length)
+
+
+from mapreduce_tpu.ops.tokenize import tokenize_hash
+
+rng = np.random.default_rng(0)
+texts = [
+    b"hello world  foo\tbar\nbaz " * 40,
+    b"x",
+    b" ",
+    b"".join(bytes(rng.integers(32, 127, rng.integers(1, 12)).astype(np.uint8))
+             + b" " for _ in range(500)),
+    b"a" * 3000 + b" b",
+]
+for t in texts:
+    for pad_to in (None, 1024, 1536, 4096):
+        n = len(t)
+        if pad_to:
+            if n > pad_to:
+                continue
+            t2 = t + b" " * (pad_to - n)
+        else:
+            t2 = t
+        chunk = jnp.asarray(np.frombuffer(t2, dtype=np.uint8))
+        exp = tokenize_hash(chunk)
+        got_end, got_keys, got_start, got_len = tokenize_pallas(chunk)
+        assert np.array_equal(np.asarray(got_end), np.asarray(exp.is_end))
+        ie = np.asarray(exp.is_end)
+        assert np.array_equal(np.asarray(got_keys)[ie],
+                              np.asarray(exp.keys)[ie]), (len(t2),)
+        assert np.array_equal(np.asarray(got_start)[ie],
+                              np.asarray(exp.start)[ie])
+        assert np.array_equal(np.asarray(got_len)[ie],
+                              np.asarray(exp.length)[ie])
+        # full-array equality too (tile_compact gathers only at ends, but
+        # pin everywhere to be strict)
+        assert np.array_equal(np.asarray(got_start), np.asarray(exp.start))
+    print(f"text len={len(t)} OK")
+print("tokenize kernel prototype OK")

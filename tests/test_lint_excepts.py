@@ -225,6 +225,17 @@ _MONOTONIC_ONLY_MODULES = {
     # reclaimer does are minted/read through coord/docstore.now)
     os.path.join("mapreduce_tpu", "obs", "control.py"),
     os.path.join("mapreduce_tpu", "engine", "autotune.py"),
+    # the Pallas hot-path plane: the kernel modules and the shared
+    # compat layer sit INSIDE traced wave programs — they must read no
+    # clocks at all (a clock read at trace time would bake a constant
+    # into a compiled program; the per-wave timing around them is the
+    # engine's job), which this lint pins down the way it pins
+    # comms.py/analysis.py.  (The tree-wide broad-except lint covers
+    # these files automatically, like the whole package.)
+    os.path.join("mapreduce_tpu", "ops", "pallas_compat.py"),
+    os.path.join("mapreduce_tpu", "ops", "segscan.py"),
+    os.path.join("mapreduce_tpu", "ops", "tokenize.py"),
+    os.path.join("mapreduce_tpu", "ops", "flash_attention.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions

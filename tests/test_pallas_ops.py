@@ -1,0 +1,400 @@
+"""Golden-equivalence suite for the Pallas hot-path kernels.
+
+The fused wave program's two worst stages have Pallas formulations
+(ops/segscan's segmented-reduce + compaction kernel, ops/tokenize's
+tokenizing map-scan), each selected by config (`segment_impl` /
+`tokenize_impl`) and each required to be BIT-identical to its lax twin
+— the engine's integer monoids make every association order exact, so
+"bit-identical" is a hard array-equality pin, not a tolerance.  Tier-1
+runs the kernels under the Pallas interpreter (ops/pallas_compat's ONE
+CPU-fallback policy), so these tests execute the real kernel logic:
+grid sequencing, cross-block scratch carries, block index maps.
+
+Coverage: ops-level equivalence over sum/min/max/custom-stacked ACI ops
+and unit_values (overflow capacities, all-invalid input, single-run and
+all-unique edge rows, sentinel-pair keys, non-block-multiple lengths);
+tokenize equivalence against both the lax twin and the host oracle
+(non-tile-multiple chunk lengths included); engine-level fold
+bit-identity with `segment_impl`/`tokenize_impl` on/off across multiple
+waves and through a capacity retry; and the analytic cost model's
+kernel-formulation terms feeding /statusz (mirroring test_profile's
+monkeypatched-fallback pattern).
+
+Fixture sizing: eager pallas-interpret calls cost ~1s each and every
+engine build is a wave-program compile, so the fast tests share ONE
+shape family (N=384, block=256 — grid of 2, so every cross-block carry
+still runs) and the tiny engines keep k=1 wave shapes; the extended
+matrix (argsort composition, three-lane verify tokenizer) is marked
+slow (the PR-11/12/13 suite-budget pattern).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mapreduce_tpu.obs import profile as obs_profile
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.ops import pallas_compat
+from mapreduce_tpu.ops.segscan import SENTINEL, sorted_unique_reduce
+from mapreduce_tpu.ops.tokenize import (
+    HASH_A1, HASH_A2, HASH_A3, tokenize_hash, word_hashes_host)
+
+#: the shared ops-level shape family: non-block-multiple N over a
+#: 2-step grid, so every test exercises the cross-block scratch carry
+N_OPS = 384
+BLOCK = 256
+
+
+# -- pallas_compat: the ONE CPU-fallback policy ------------------------------
+
+
+def test_default_interpret_policy():
+    """Off-TPU (the tier-1 mesh) the kernels auto-select the
+    interpreter; explicit bools win either way."""
+    import jax
+
+    assert pallas_compat.default_interpret(None) == (
+        jax.default_backend() != "tpu")
+    assert pallas_compat.default_interpret(True) is True
+    assert pallas_compat.default_interpret(False) is False
+
+
+def test_flash_attention_ports_onto_pallas_compat():
+    """The satellite: flash_attention's interpret default and block
+    fitting are the shared spellings, not private copies."""
+    from mapreduce_tpu.ops import flash_attention as fa
+
+    assert fa._pick_block is pallas_compat.pick_block
+    assert fa._sds is pallas_compat.sds
+    # the resolved cfgt carries the shared policy's answer
+    import jax
+
+    q = jnp.zeros((1, 1, 16, 8), jnp.float32)
+    cfgt = fa._make_cfgt(q, q, True, None, 8, 8, None)
+    assert cfgt[4] == (jax.default_backend() != "tpu")
+
+
+# -- ops-level: segmented-reduce kernel == lax ladder ------------------------
+
+
+def _pin_equal(a, b, ctx):
+    for f in a._fields:
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert np.array_equal(x, y), (f, ctx)
+
+
+def _both(keys, vals, pay, valid, cap, op, unit=False, block=BLOCK,
+          sort_impl="variadic"):
+    kw = dict(unit_values=unit, sort_impl=sort_impl)
+    a = sorted_unique_reduce(jnp.asarray(keys), jnp.asarray(vals),
+                             jnp.asarray(pay), jnp.asarray(valid),
+                             cap, op, segment_impl="lax", **kw)
+    b = sorted_unique_reduce(jnp.asarray(keys), jnp.asarray(vals),
+                             jnp.asarray(pay), jnp.asarray(valid),
+                             cap, op, segment_impl="pallas",
+                             segment_block=block, **kw)
+    return a, b
+
+
+def _ops_case(seed, key_range=40):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, key_range, size=(N_OPS, 2)).astype(np.uint32)
+    vals = rng.integers(-50, 100, size=N_OPS).astype(np.int32)
+    pay = np.arange(N_OPS, dtype=np.int32)[:, None]
+    valid = rng.random(N_OPS) < 0.8
+    return keys, vals, pay, valid
+
+
+def test_segreduce_kernel_builtin_ops_bit_identical():
+    """sum/min/max over one shared shape family (the kernel-build
+    counter's delta doubles as the registry witness)."""
+    b0 = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                      kernel="segreduce")
+    keys, vals, pay, valid = _ops_case(3)
+    for op in ("sum", "min", "max"):
+        a, b = _both(keys, vals, pay, valid, 128, op)
+        _pin_equal(a, b, op)
+        assert int(a.n_unique) > 0
+    assert REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                        kernel="segreduce") > b0
+
+
+def test_segreduce_kernel_custom_stacked_op_bit_identical():
+    """The collision-verify shape: a 3-lane ACI monoid (sum, min, max)
+    over stacked values — the arbitrary-callable path the device
+    contract licenses (reducefn.lua's flags, compiler-visible)."""
+    rng = np.random.default_rng(4)
+    keys = rng.integers(0, 12, size=(N_OPS, 2)).astype(np.uint32)
+    vals = rng.integers(0, 1000, size=(N_OPS, 3)).astype(np.int32)
+    pay = np.zeros((N_OPS, 1), np.int32)
+    valid = rng.random(N_OPS) < 0.9
+
+    def vop(x, y):
+        return jnp.stack([x[..., 0] + y[..., 0],
+                          jnp.minimum(x[..., 1], y[..., 1]),
+                          jnp.maximum(x[..., 2], y[..., 2])], axis=-1)
+
+    a, b = _both(keys, vals, pay, valid, 64, vop)
+    _pin_equal(a, b, "stacked")
+
+
+def test_segreduce_kernel_unit_values_and_overflow():
+    """Run-length counting (the wordcount fast path) and the overflow
+    signal: capacity smaller than the unique count must report the SAME
+    n_unique (> capacity) from both formulations."""
+    keys = np.stack([np.arange(N_OPS, dtype=np.uint32) % 97,
+                     np.zeros(N_OPS, np.uint32)], axis=-1)
+    vals = np.zeros(N_OPS, np.int32)
+    pay = np.arange(N_OPS, dtype=np.int32)[:, None]
+    valid = np.ones(N_OPS, bool)
+    a, b = _both(keys, vals, pay, valid, 16, "sum", unit=True)
+    _pin_equal(a, b, "unit-overflow")
+    assert int(a.n_unique) == 97 > 16  # overflow signalled identically
+
+
+def test_segreduce_kernel_edge_rows():
+    """All-invalid input, plus a mixed edge array: one giant run
+    spanning the block boundary, real sentinel-pair keys, and an
+    all-unique tail — the boundary-detection edge cases in two calls."""
+    pay = np.arange(N_OPS, dtype=np.int32)[:, None]
+    vals = np.arange(N_OPS, dtype=np.int32)
+    # all invalid
+    a, b = _both(np.zeros((N_OPS, 2), np.uint32), vals, pay,
+                 np.zeros(N_OPS, bool), 8, "sum")
+    _pin_equal(a, b, "all-invalid")
+    assert int(a.n_unique) == 0
+    # mixed: 300 copies of one key (a single run crossing the 256-el
+    # block boundary after the sort), 4 sentinel-pair keys (remapped to
+    # (0,0), never dropped), and an all-unique tail
+    S = int(SENTINEL)
+    keys = np.concatenate([
+        np.full((300, 2), 7, np.uint32),
+        np.full((4, 2), S, np.uint32),
+        np.stack([np.arange(100, 100 + N_OPS - 304, dtype=np.uint32)] * 2,
+                 axis=-1)])
+    a, b = _both(keys, vals, pay, np.ones(N_OPS, bool), N_OPS, "sum")
+    _pin_equal(a, b, "mixed-edges")
+    assert int(a.n_unique) == 2 + (N_OPS - 304)  # (0,0), (7,7), uniques
+
+
+@pytest.mark.slow
+def test_segreduce_kernel_composes_with_argsort_tier():
+    """segment_impl rides orthogonally to sort_impl: the tier-0 argsort
+    permutation feeding the kernel must still pin bit-identical."""
+    keys, vals, pay, valid = _ops_case(6, key_range=20)
+    a, b = _both(keys, vals, pay, valid, 64, "sum", sort_impl="argsort")
+    _pin_equal(a, b, "argsort+pallas")
+    lax_var = sorted_unique_reduce(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(pay),
+        jnp.asarray(valid), 64, "sum")
+    _pin_equal(lax_var, b, "variadic-lax vs argsort-pallas")
+
+
+def test_segreduce_rejects_unknown_impl():
+    with pytest.raises(ValueError, match="segment_impl"):
+        sorted_unique_reduce(jnp.zeros((4, 2), jnp.uint32),
+                             jnp.zeros(4, jnp.int32),
+                             jnp.zeros((4, 1), jnp.int32),
+                             jnp.ones(4, bool), 4, "sum",
+                             segment_impl="mosaic")
+
+
+# -- ops-level: tokenizing map-scan kernel == lax ladders --------------------
+
+
+def test_tokenize_kernel_bit_identical_and_host_oracle():
+    """The kernel TokenStream equals the lax twin field-for-field AND
+    the host oracle's hash set — every separator byte class, a raw odd
+    length and a non-block-multiple padded length."""
+    rng = np.random.default_rng(7)
+    words = [bytes(rng.integers(33, 127, rng.integers(1, 11))
+                   .astype(np.uint8)) for _ in range(80)]
+    # raw odd length — NOT a multiple of the kernel block, so the
+    # space-padding path and the padded-tail boundary both execute
+    text = b" ".join(words) + b"\ttab\nnl\rcr\x0bvt\x0cff end"
+    assert len(text) % BLOCK != 0
+    chunk = jnp.asarray(np.frombuffer(text, np.uint8))
+    lax = tokenize_hash(chunk)
+    pal = tokenize_hash(chunk, impl="pallas", block=BLOCK)
+    for f in lax._fields:
+        assert np.array_equal(np.asarray(getattr(lax, f)),
+                              np.asarray(getattr(pal, f))), f
+    ie = np.asarray(pal.is_end)
+    got = set(map(tuple, np.asarray(pal.keys)[ie].tolist()))
+    assert got == set(word_hashes_host(text).values())
+
+
+@pytest.mark.slow
+def test_tokenize_kernel_three_lane_verify_mode():
+    """Collision-verify mode's third hash lane rides the same kernel."""
+    text = b"alpha beta beta gamma  gamma gamma " * 8
+    chunk = jnp.asarray(np.frombuffer(text, np.uint8))
+    mult = (HASH_A1, HASH_A2, HASH_A3)
+    lax = tokenize_hash(chunk, multipliers=mult)
+    pal = tokenize_hash(chunk, multipliers=mult, impl="pallas",
+                        block=128)
+    for f in lax._fields:
+        assert np.array_equal(np.asarray(getattr(lax, f)),
+                              np.asarray(getattr(pal, f))), f
+
+
+def test_tokenize_rejects_unknown_impl():
+    with pytest.raises(ValueError, match="impl"):
+        tokenize_hash(jnp.zeros(128, jnp.uint8), impl="triton")
+
+
+# -- engine-level: fold bit-identity, kernel config on/off -------------------
+#
+# Suite-budget note: every distinct EngineConfig is a wave-program
+# compile.  These fixtures keep k=1 wave shapes (tiny corpora), skip
+# the in-scan combiner (the bench smoke's pallas gate covers
+# combine_in_scan=True + kernels), and the statusz test below reuses
+# EXACTLY these configs so the compile ledger serves it from cache.
+
+
+def _tiny_wc(segment_impl="lax", tokenize_impl="lax", out_capacity=1024):
+    from mapreduce_tpu.engine import DeviceWordCount
+    from mapreduce_tpu.engine.device_engine import EngineConfig
+    from mapreduce_tpu.parallel import make_mesh
+
+    return DeviceWordCount(
+        make_mesh(), chunk_len=2048,
+        config=EngineConfig(local_capacity=1024, exchange_capacity=256,
+                            out_capacity=out_capacity, tile=512,
+                            tile_records=128,
+                            segment_impl=segment_impl,
+                            tokenize_impl=tokenize_impl,
+                            segment_block=1024, tokenize_block=1024))
+
+
+def test_engine_fold_bit_identity_multiwave():
+    """The tentpole's engine-level pin: the full fused wave program —
+    map (kernel tokenizer) -> sort -> exchange -> fold (kernel
+    segmented reduce) — produces the identical result dict across 3
+    waves with the kernels on vs off, one dispatch per wave intact."""
+    corpus = b"the quick brown fox jumps over the lazy dog " * 400
+    d0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    tm_l = {}
+    counts_lax = _tiny_wc().count_bytes(corpus, timings=tm_l, waves=3)
+    d1 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    tm_p = {}
+    counts_pal = _tiny_wc("pallas", "pallas").count_bytes(
+        corpus, timings=tm_p, waves=3)
+    d2 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    assert counts_pal == counts_lax
+    assert counts_pal[b"the"] == 800
+    assert tm_l["waves"] == tm_p["waves"] >= 2
+    assert tm_l["retries"] == tm_p["retries"] == 0
+    # the fused execution model holds under the kernel config too
+    assert d2 - d1 == tm_p["waves"]
+    assert d1 - d0 == tm_l["waves"]
+
+
+def test_engine_fold_bit_identity_through_capacity_retry():
+    """Capacity-retry convergence with the kernel config: a deliberately
+    under-sized out_capacity overflows, the right-sized recompile re-runs
+    the kernels at the new shapes, and the converged fold still equals
+    ground truth (the host split of the same bytes)."""
+    # ~97 uniques over 8 partitions vs out_capacity 8 PER PARTITION:
+    # the final fold stage overflows, right-sizes, converges
+    words = [f"w{i:03d}".encode() for i in range(97)]
+    corpus = (b" ".join(words) + b" ") * 30
+    tm_p = {}
+    counts_pal = _tiny_wc("pallas", "pallas", out_capacity=8).count_bytes(
+        corpus, timings=tm_p, waves=2)
+    assert tm_p["retries"] >= 1
+    from collections import Counter
+
+    truth = {bytes(w): c for w, c in Counter(corpus.split()).items()}
+    assert counts_pal == truth
+    assert len(counts_pal) == 97 and counts_pal[words[0]] == 30
+
+
+# -- CLI/device-hook passthrough ---------------------------------------------
+
+
+def test_device_hooks_and_cli_flags_pass_kernel_impls():
+    """`cli wordcount --device --segment-impl/--tokenize-impl` lands in
+    init_args as device_segment_impl/device_tokenize_impl, which the
+    wordcount module's device_config reads (cheap: no engine is built)."""
+    from mapreduce_tpu.examples.wordcount import _conf, device_config
+
+    saved = dict(_conf)
+    try:
+        _conf["device_segment_impl"] = "pallas"
+        _conf["device_tokenize_impl"] = "pallas"
+        cfg = device_config()
+        assert cfg.segment_impl == "pallas"
+        assert cfg.tokenize_impl == "pallas"
+        _conf.pop("device_segment_impl")
+        _conf.pop("device_tokenize_impl")
+        cfg = device_config()
+        assert cfg.segment_impl == "lax" and cfg.tokenize_impl == "lax"
+    finally:
+        _conf.clear()
+        _conf.update(saved)
+    # the CLI surface refuses an unknown impl at the argparse layer
+    # (nothing heavy runs: the error precedes any engine work)
+    from mapreduce_tpu import cli as cli_mod
+
+    with pytest.raises(SystemExit):
+        cli_mod.cmd_wordcount(["f", "--segment-impl", "bogus"])
+
+
+def test_engine_config_rejects_unknown_kernel_impls():
+    from mapreduce_tpu.engine.device_engine import DeviceEngine, EngineConfig
+    from mapreduce_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="segment_impl"):
+        DeviceEngine(make_mesh(), lambda c, i, f: None,
+                     EngineConfig(segment_impl="mosaic"))
+    with pytest.raises(ValueError, match="tokenize_impl"):
+        DeviceEngine(make_mesh(), lambda c, i, f: None,
+                     EngineConfig(tokenize_impl="host"))
+
+
+# -- cost model: the kernel formulation reaches /statusz ---------------------
+
+
+def test_analytic_costs_kernel_terms_differ_and_stay_monotone():
+    """analytic_costs(segment_impl=...) models the two programs
+    differently: the lax ladder pays more flops AND more record-buffer
+    bytes than the kernel's single fused pass, at every size."""
+    for n in (1 << 10, 1 << 16):
+        lax = obs_profile.analytic_costs(1 << 20, n, 16,
+                                         fold_records=256)
+        pal = obs_profile.analytic_costs(1 << 20, n, 16,
+                                         fold_records=256,
+                                         segment_impl="pallas")
+        assert pal["flops"] < lax["flops"]
+        assert pal["bytes"] < lax["bytes"]
+        assert pal["flops"] > 0 and pal["bytes"] > (1 << 20)
+
+
+def test_statusz_reports_kernel_formulation_costs(monkeypatch):
+    """The acceptance criterion, mirroring test_profile's
+    monkeypatched-fallback pattern: with XLA's cost model disabled, a
+    pallas-served run's recorded costs (and hence the /statusz
+    roofline/MFU section) come from the KERNEL formulation — strictly
+    below the lax terms for the same workload — labelled analytic.
+    (The corpora keep the k=1 wave shape, so both engines are served
+    from the executables the multiwave test compiled.)"""
+    from mapreduce_tpu.engine import device_engine as de
+
+    monkeypatch.setattr(de._profile, "program_costs",
+                        lambda compiled: None)
+    corpus = b"fall back to analytic kernel terms " * 120
+    tm_l = {}
+    _tiny_wc().count_bytes(corpus, timings=tm_l)
+    tm_p = {}
+    _tiny_wc("pallas", "pallas").count_bytes(corpus, timings=tm_p)
+    assert tm_l["cost_source"] == tm_p["cost_source"] == "analytic"
+    assert 0 < tm_p["flops"] < tm_l["flops"]
+    assert 0 < tm_p["cost_bytes"] < tm_l["cost_bytes"]
+    # the gauges the /statusz device section serves carry the
+    # kernel-formulation numbers (record_run ran last for the pallas
+    # engine)
+    snap = obs_profile.device_snapshot()
+    assert snap["mfu"] > 0
+    assert snap["flops_total"] > 0
