@@ -32,7 +32,11 @@ strips ``.lua``):
       queued jobs never run).
   python -m mapreduce_tpu.cli runner CONNSTR [--workers N] — the
       always-on serving process: lease-fenced admission + task drivers
-      + one cross-tenant worker pool.
+      + one cross-tenant worker pool; joins the engine-host fleet
+      under hostname:pid and heartbeats its mesh facts.
+  python -m mapreduce_tpu.cli drain CONNSTR HOST — upgrade-safe host
+      removal: flag the host, wait for it to step down, re-home its
+      streams to live hosts (lazy restore from the spill store).
   python -m mapreduce_tpu.cli train CONNSTR DB [--storage DSL] —
       elastic, preemption-tolerant training: trainer lease through the
       job board, sharded checkpoints through the blob plane,
@@ -933,6 +937,32 @@ def _render_sched(sched: dict) -> List[str]:
     return lines
 
 
+def _render_fleet(fleet: dict) -> List[str]:
+    """The engine-fleet section of /statusz (coord/fleet): per-host
+    membership state, lease headroom, heartbeat mesh facts, and how
+    many streams route to each host."""
+    if not fleet or not fleet.get("hosts"):
+        return []
+    lines = ["engine fleet: {} host(s), {} routed stream(s){}".format(
+        len(fleet["hosts"]), fleet.get("routes", 0),
+        ("  [{} routed at NO registered host]".format(
+            fleet["routes_unhosted"])
+         if fleet.get("routes_unhosted") else ""))]
+    for host, h in sorted(fleet["hosts"].items()):
+        frac = h.get("hbm_frac")
+        state = str(h.get("state", "?"))
+        # a left/expired host's lease stamp is history, not headroom
+        lease = ("{:+.1f}s".format(h.get("lease_expires_in") or 0.0)
+                 if state in ("live", "draining") else "-")
+        lines.append(
+            "  host {}: {}  gen {}  lease {}  "
+            "{} stream(s)  {} warm program(s)  hbm {}".format(
+                host, state.upper(), h.get("generation", 0), lease,
+                h.get("streams", 0), h.get("warm_programs", 0),
+                "-" if frac is None else f"{frac:.0%}"))
+    return lines
+
+
 def _render_slo(slo: dict) -> List[str]:
     """The serving-SLO section of /statusz (obs/slo): per-tenant
     objective percentiles, burn rates and breach state against the
@@ -1065,6 +1095,7 @@ def render_status(snap: dict) -> str:
     lines += _render_comms(snap.get("comms") or {})
     lines += _render_checkpoint(snap.get("checkpoint") or {})
     lines += _render_sched(snap.get("sched") or {})
+    lines += _render_fleet(snap.get("fleet") or {})
     lines += _render_slo(snap.get("slo") or {})
     lines += _render_control(snap.get("control") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
@@ -1587,6 +1618,7 @@ def cmd_runner(argv: List[str]) -> int:
     _setup_compile_cache(args)
 
     from .coord import docstore
+    from .coord.fleet import FleetMember, FleetRegistry, default_host_id
     from .obs.collector import acquire_pusher, release_pusher
     from .sched.scheduler import Scheduler, SchedulerConfig
     from .sched.service import TaskRunner, spawn_scheduled_workers
@@ -1599,16 +1631,30 @@ def cmd_runner(argv: List[str]) -> int:
     # telemetry-informed admission (ON for the CLI surface): the
     # runner process hosts the admitted tasks' device engines, so ITS
     # compile-ledger warmth + HBM headroom are the placement facts —
-    # registered as mesh "local" now and refreshed while serving.
-    # With nothing registered the advisor is a strict no-op; warm
-    # picks (and any multi-mesh choice an embedder registers) land in
-    # the control ledger
+    # registered under this process's UNIQUE fleet host id
+    # (hostname:pid; two runners on one board must not clobber each
+    # other) and refreshed while serving.  With nothing registered the
+    # advisor is a strict no-op; warm picks (and any multi-mesh choice
+    # an embedder registers) land in the control ledger
     advisor = AdmissionAdvisor()
+    host_id = default_host_id()
     warm, hbm = local_mesh_facts()
-    advisor.register_mesh("local", warm_programs=warm, hbm_frac=hbm)
+    advisor.register_mesh(host_id, warm_programs=warm, hbm_frac=hbm)
+    # join the engine-host fleet: the same facts heartbeat to the
+    # board so a docserver-side scheduler places across EVERY runner,
+    # `cli drain` can ask this one to step down, and a SIGKILL here is
+    # recovered by the scheduler's failed-host sweep one lease later
+    member = FleetMember(store, host_id=host_id)
+    try:
+        member.join(timeout=10.0, warm_programs=warm, hbm_frac=hbm)
+    except (OSError, TimeoutError) as exc:
+        print(f"fleet join failed ({exc}); serving without fleet "
+              "membership", file=sys.stderr)
+        member = None
     scheduler = Scheduler(
         store, config=SchedulerConfig(max_inflight=args.max_inflight),
-        advisor=advisor)
+        advisor=advisor,
+        fleet=FleetRegistry(store) if member is not None else None)
     # normalized HOST:PORT (the one embedded-token parser): a TOKEN@
     # connstr must key the SAME shared pusher the pool's workers use,
     # never a second one under a token-bearing address string
@@ -1636,8 +1682,30 @@ def cmd_runner(argv: List[str]) -> int:
             # keep the advisor's placement facts live: warmth grows as
             # tasks compile, HBM gauges move at every engine wave
             warm, hbm = local_mesh_facts()
-            advisor.register_mesh("local", warm_programs=warm,
+            advisor.register_mesh(host_id, warm_programs=warm,
                                   hbm_frac=hbm)
+            if member is not None:
+                # fleet heartbeat: liveness + the same facts in one
+                # guarded write; the post-image carries the board's
+                # requests back (the `cli drain` flag)
+                try:
+                    doc = member.heartbeat(warm_programs=warm,
+                                           hbm_frac=hbm)
+                except OSError:
+                    doc = {}  # transport blip: proves nothing
+                if doc is None:
+                    # definitive loss (reaped/superseded): our streams
+                    # may already serve elsewhere — rejoin as fresh
+                    try:
+                        member.join(timeout=2.0, warm_programs=warm,
+                                    hbm_frac=hbm)
+                    except (OSError, TimeoutError):
+                        pass
+                elif doc.get("drain"):
+                    print(f"drain requested for host {host_id}: "
+                          "stepping down (streams re-home via the "
+                          "fleet routes + spill store)", flush=True)
+                    break
             if any(w.failed is not None for w in pool):
                 break
         failure = runner.failed or next(
@@ -1652,9 +1720,98 @@ def cmd_runner(argv: List[str]) -> int:
         runner.stop()
         for w in pool:
             w.stop()
+        if member is not None:
+            try:
+                # clean departure: the host shows as LEFT (not
+                # expired), so no recovery sweep fires for a shutdown
+                member.leave()
+            except OSError:
+                pass  # board gone too; the sweep will reap us
         release_pusher(tele)
     _export_trace(args, rec)
     return rc
+
+
+def cmd_drain(argv: List[str]) -> int:
+    """Upgrade-safe host removal: flag the engine host for drain (it
+    sees the flag on its next heartbeat, steps down and releases its
+    lease), wait for it to leave, then re-home every stream routed at
+    it to the best live hosts (coord/fleet.rehome_routes — guarded
+    route flips, scored like admission, each move a control-ledger
+    decision).  The streams are durable in the spill store, so the
+    re-home is a route flip: the destinations pay a lazy restore on
+    each stream's next feed/snapshot."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu drain")
+    p.add_argument("connstr", help="the job board (same CONNSTR the "
+                                   "runner serves)")
+    p.add_argument("host", help="fleet host id (hostname:pid — the "
+                                "`cli status` fleet section lists "
+                                "them)")
+    p.add_argument("--timeout", type=float, default=30.0, metavar="S",
+                   help="seconds to wait for the host to see the flag "
+                        "and leave before re-homing anyway")
+    _add_auth(p)
+    _add_retry(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose or 1)
+
+    import time as _time
+
+    from .coord import docstore
+    from .coord.fleet import FleetRegistry, host_state, rehome_routes
+    from .obs import control as _control
+
+    retry = _retry_policy(args)
+    store = docstore.connect(args.connstr, auth=args.auth, retry=retry)
+    try:
+        reg = FleetRegistry(store)
+
+        def _doc():
+            return next((d for d in reg.hosts()
+                         if str(d["_id"]) == args.host), None)
+
+        doc = _doc()
+        if doc is None:
+            print(f"no such fleet host: {args.host!r} (see the fleet "
+                  "section of `cli status`)", file=sys.stderr)
+            return 2
+        state = host_state(doc, docstore.now())
+        if state in ("live", "draining"):
+            reg.request_drain(args.host)
+            print(f"drain requested for {args.host} ({state}); "
+                  f"waiting up to {args.timeout:.0f}s for it to step "
+                  "down...", flush=True)
+            give_up = _time.monotonic() + args.timeout
+            while _time.monotonic() < give_up:
+                doc = _doc()
+                if doc is None or host_state(
+                        doc, docstore.now()) in ("left", "expired"):
+                    break
+                _time.sleep(0.25)
+            else:
+                print(f"host {args.host} did not leave within "
+                      f"{args.timeout:.0f}s; re-homing its routes "
+                      "anyway (its guarded writes fence once the "
+                      "routes move)", file=sys.stderr)
+        moves = rehome_routes(reg, args.host, reason="drain",
+                              ledger=_control.LEDGER)
+        for task, dst in moves:
+            print(f"  re-homed stream {task} -> {dst}")
+        left = reg.routes_for(args.host)
+        doc = _doc()
+        print("host {} {}: {} stream(s) re-homed, {} still routed "
+              "here{}".format(
+                  args.host,
+                  host_state(doc, docstore.now()) if doc else "gone",
+                  len(moves), len(left),
+                  "" if not left else
+                  " (no live destination yet — the scheduler's next "
+                  "sweep retries)"))
+        return 0 if not left else 1
+    except OSError as exc:
+        print(f"cannot reach {args.connstr}: {exc}", file=sys.stderr)
+        return 1
 
 
 def cmd_warmup(argv: List[str]) -> int:
@@ -1800,7 +1957,7 @@ COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "profile": cmd_profile, "timeline": cmd_timeline,
             "diagnose": cmd_diagnose, "train": cmd_train,
             "submit": cmd_submit, "tasks": cmd_tasks,
-            "runner": cmd_runner}
+            "runner": cmd_runner, "drain": cmd_drain}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

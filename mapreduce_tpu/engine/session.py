@@ -86,10 +86,12 @@ _OVERFLOWS = _obs.counter(
     "nonzero value means that stream's aggregate is truncated")
 _BACKPRESSURE = _obs.counter(
     "mrtpu_session_backpressure_total",
-    "feeds refused because the stream's bounded pending-feed queue "
-    "was full (labels: task, reason=feed_queue) — the loud-rejection "
-    "half of the serving latency contract: a session never queues "
-    "unboundedly behind a slow mesh")
+    "feeds/snapshots refused with retry-after semantics (labels: "
+    "task, reason=feed_queue|migrating) — feed_queue: the stream's "
+    "bounded pending-feed queue was full (the loud-rejection half of "
+    "the serving latency contract: a session never queues unboundedly "
+    "behind a slow mesh); migrating: the stream was just handed off "
+    "to another engine host and serves at its new route")
 _STREAM_AGE = _obs.gauge(
     "mrtpu_session_stream_age_seconds",
     "seconds since a resident stream's last feed / last snapshot "
@@ -165,10 +167,15 @@ class SessionStreamBroken(RuntimeError):
 
 
 class SessionBusyError(RuntimeError):
-    """A feed was refused because *task*'s bounded pending-feed queue
-    was full (``max_pending_feeds``): the mesh is not keeping up with
-    this stream's arrival rate.  Backpressure by contract — the caller
-    sheds or slows; the session never queues unboundedly."""
+    """A feed (or snapshot) was refused with RETRY-AFTER semantics:
+    either *task*'s bounded pending-feed queue was full
+    (``max_pending_feeds`` — the mesh is not keeping up with this
+    stream's arrival rate; shed or slow), or the stream was just
+    HANDED OFF to another engine host (:meth:`EngineSession.
+    migrate_out`) — it is alive and durable, just not HERE; the caller
+    re-resolves the task's route and retries at the destination.
+    Never a stream-death signal (that is
+    :class:`SessionStreamBroken`)."""
 
 
 class _Stream:
@@ -238,6 +245,14 @@ class EngineSession:
         self._row_shape: Optional[tuple] = None
         self._row_dtype = None
         self._streams: Dict[str, _Stream] = {}
+        #: tasks this session HANDED OFF to another host
+        #: (:meth:`migrate_out`): their spilled checkpoints belong to
+        #: the destination now, so the lazy-restore path must refuse
+        #: them here — restoring would fork the stream (both hosts
+        #: folding, each blind to the other's feeds).  Cleared by an
+        #: explicit :meth:`restore` (the stream was routed back) or
+        #: :meth:`close`.
+        self._handed_off: set = set()
         self._lock = threading.Lock()
         #: spill/restore plane (engine/spill.py): evicted streams
         #: checkpoint here and restore lazily on their next feed
@@ -301,6 +316,7 @@ class EngineSession:
     def _stream(self, task: str) -> _Stream:
         st = self._streams.get(task)
         if st is None:
+            self._refuse_handed_off(task)
             # lazy restore: an evicted (or host-crashed) stream with a
             # spilled checkpoint comes back transparently on its next
             # touch — on THIS mesh, whatever mesh it was saved under
@@ -316,6 +332,19 @@ class EngineSession:
 
     def _refresh_resident(self) -> None:
         _RESIDENT.set(len(self._streams), task="-")
+
+    def _refuse_handed_off(self, task: str) -> None:
+        """The migration split-brain guard (call under the lock): a
+        stream this session handed to another host must not lazily
+        restore HERE — a feed that raced the evict gets retry-after
+        semantics (the stream is alive at its new route), never a
+        silent fork and never :class:`SessionStreamBroken`."""
+        if task in self._handed_off:
+            _BACKPRESSURE.inc(task=task, reason="migrating")
+            raise SessionBusyError(
+                f"stream {task!r} was migrated off this host; its "
+                "checkpoint belongs to the destination now — "
+                "re-resolve the task's route and retry there")
 
     def _wave_fn(self):
         """The session's wave callable: the compiled program, or (for
@@ -518,6 +547,8 @@ class EngineSession:
         t0 = time.monotonic()
         with self._lock:
             st = self._streams.get(task)
+            if st is None:
+                self._refuse_handed_off(task)
             if (st is None and self.spill is not None
                     and self.spill.has(task)):
                 # an evicted stream is still SERVABLE: restore lazily
@@ -585,6 +616,25 @@ class EngineSession:
                 out["segment_impl"] = self.config.segment_impl
                 out["tokenize_impl"] = self.config.tokenize_impl
             return out
+
+    def coldest_task(self) -> Optional[str]:
+        """The resident stream with the OLDEST last touch (feed or
+        snapshot) — the fleet rebalancer's victim pick: migrating the
+        coldest stream frees HBM at the least serving cost, and the
+        hot stream causing the pressure keeps its warm placement.
+        Poisoned streams are skipped (restore() is their path, not a
+        migration).  None when nothing is resident."""
+        with self._lock:
+            best: Optional[str] = None
+            best_t: Optional[float] = None
+            for task, st in self._streams.items():
+                if st.broken:
+                    continue
+                t = max(st.last_feed_monotonic or 0.0,
+                        st.last_snapshot_monotonic or 0.0)
+                if best_t is None or t < best_t:
+                    best, best_t = task, t
+            return best
 
     # -- skew-aware repartition (engine/autotune.RepartitionController) ----
 
@@ -753,6 +803,32 @@ class EngineSession:
         refresh_stream_age_gauges()
         return step
 
+    def migrate_out(self, task: Optional[str] = None,
+                    reason: str = "migration") -> int:
+        """The source half of a live migration: spill *task*'s resident
+        accumulator, drop it, and mark the stream HANDED OFF — from
+        this call on, a feed or snapshot that raced the evict (waiting
+        on the session lock) gets :class:`SessionBusyError` retry-after
+        semantics instead of lazily restoring the checkpoint that now
+        belongs to the destination host.  Returns the committed spill
+        step.  A stream that is already evicted (spilled, not resident)
+        just gains the mark — its durable checkpoint IS the handoff."""
+        task = self.default_task if task is None else str(task)
+        with self._lock:
+            if task in self._streams:
+                step = self._spill_locked(task, reason)
+                self._streams.pop(task, None)
+            elif self.spill is not None and self.spill.has(task):
+                step = 0  # already durable: nothing resident to spill
+            else:
+                raise KeyError(
+                    f"no resident or spilled stream {task!r} to "
+                    "migrate")
+            self._handed_off.add(task)
+            self._refresh_resident()
+        refresh_stream_age_gauges()
+        return step
+
     def _restore_locked(self, task: str) -> _Stream:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -831,6 +907,17 @@ class EngineSession:
                            task=task)
         return st
 
+    def adopt(self, task: Optional[str] = None) -> None:
+        """The destination half of a migration handoff: lift any
+        handed-off refusal this session holds for *task* so its next
+        feed/snapshot lazily restores the migrated checkpoint.  A
+        fresh destination needs no adopt (nothing was handed off from
+        it); a stream migrating BACK to a former source does — the
+        route came home, so the refusal must lift."""
+        task = self.default_task if task is None else str(task)
+        with self._lock:
+            self._handed_off.discard(task)
+
     def restore(self, task: Optional[str] = None) -> _Stream:
         """Explicitly restore *task* from its newest complete spill —
         including OVER a poisoned stream: the broken resident state is
@@ -848,6 +935,10 @@ class EngineSession:
             # failed restore (every candidate corrupt) must not also
             # destroy a healthy resident accumulator
             st = self._restore_locked(task)
+            # an EXPLICIT restore is re-adoption: the scheduler routed
+            # the stream back here (or this host is the migration
+            # destination) — the handed-off refusal lifts
+            self._handed_off.discard(task)
             self._refresh_resident()
         refresh_stream_age_gauges()
         return st
@@ -904,8 +995,10 @@ class EngineSession:
         with self._lock:
             if task is not None:
                 self._streams.pop(str(task), None)
+                self._handed_off.discard(str(task))
             else:
                 self._streams.clear()
+                self._handed_off.clear()
             self._refresh_resident()
         if self.spill is not None and drop_spill and task is not None:
             self.spill.drop(str(task))

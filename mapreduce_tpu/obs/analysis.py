@@ -805,6 +805,57 @@ def _durability_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# -- engine-fleet findings (coord/fleet + engine/migrate) --------------------
+
+
+def _fleet_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Engine-fleet health from the cluster-aggregated fleet families
+    (coord/fleet + engine/migrate): membership states, stream
+    migrations by reason, failed-host recoveries, and definitive
+    heartbeat losses (a host that was fenced off its own lease).
+    Per-migration evidence rides the control ledger (controller
+    ``fleet``) and surfaces through the control findings; this section
+    is the counter-level roll-up."""
+    out: Dict[str, Any] = {}
+    hosts: Dict[str, int] = {}
+    migrations: Dict[str, int] = {}
+    migrated_tasks: Dict[str, int] = {}
+    recovered: Dict[str, int] = {}
+    lost_beats: Dict[str, int] = {}
+    for name, labels, value in _metric_rows(doc):
+        if name == "mrtpu_fleet_hosts":
+            if value:
+                state = labels.get("state", "-")
+                # gauge: each serving process renders the same board
+                # truth, so MAX (not sum) avoids double counting
+                hosts[state] = max(hosts.get(state, 0), int(value))
+        elif not value:
+            continue
+        elif name == "mrtpu_session_migrations_total":
+            r = labels.get("reason", "-")
+            migrations[r] = migrations.get(r, 0) + int(value)
+            t = labels.get("task", "-")
+            migrated_tasks[t] = migrated_tasks.get(t, 0) + int(value)
+        elif name == "mrtpu_fleet_recoveries_total":
+            h = labels.get("host", "-")
+            recovered[h] = recovered.get(h, 0) + int(value)
+        elif name == "mrtpu_fleet_heartbeats_total":
+            if labels.get("outcome") == "lost":
+                h = labels.get("host", "-")
+                lost_beats[h] = lost_beats.get(h, 0) + int(value)
+    if hosts:
+        out["hosts"] = hosts
+    if migrations:
+        out["migrations"] = migrations
+    if migrated_tasks:
+        out["migrated_tasks"] = migrated_tasks
+    if recovered:
+        out["recovered_hosts"] = recovered
+    if lost_beats:
+        out["heartbeat_losses"] = lost_beats
+    return out
+
+
 # -- serving-SLO findings (obs/slo) ------------------------------------------
 
 
@@ -898,6 +949,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "sched": _sched_findings(doc),
         "slo": _slo_findings(doc),
         "durability": _durability_findings(doc),
+        "fleet": _fleet_findings(doc),
         "control": control,
         "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
@@ -1073,6 +1125,25 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
             f"session stream {task} refused {n} feed(s) at its "
             "bounded pending queue — the mesh is behind this stream's "
             "arrival rate (shed load or grow the mesh)")
+    fleet = report["fleet"]
+    if fleet.get("migrations"):
+        total = sum(fleet["migrations"].values())
+        notes.append(
+            "fleet: {} stream migration(s) ({}) — each one's evidence "
+            "is a control-ledger decision above".format(
+                total, ", ".join(f"{r}={n}" for r, n in
+                                 sorted(fleet["migrations"].items()))))
+    for host, n in sorted((fleet.get("recovered_hosts") or {}).items()):
+        notes.append(
+            "fleet: host {} died (lease expired) and was reaped by the "
+            "recovery sweep{} — its streams were re-homed to live "
+            "hosts and are servable again via lazy restore".format(
+                host, f" {n} time(s)" if n > 1 else ""))
+    if fleet.get("hosts", {}).get("expired"):
+        notes.append(
+            "fleet: {} host(s) currently hold an expired lease — the "
+            "next scheduler sweep will re-home their streams".format(
+                fleet["hosts"]["expired"]))
     hot_compile = report["compile_hotspots"]
     if hot_compile and hot_compile[0]["total_s"] >= 5.0:
         h = hot_compile[0]
@@ -1210,6 +1281,21 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
             lines.append(f"  tenant {t}: {parts}")
         for t, n in sorted((sched.get("served_records") or {}).items()):
             lines.append(f"  tenant {t}: {n} records served")
+
+    fleet = report.get("fleet") or {}
+    if fleet:
+        lines.append("engine fleet:")
+        if fleet.get("hosts"):
+            lines.append("  hosts: " + "  ".join(
+                f"{s}={n}" for s, n in sorted(fleet["hosts"].items())))
+        if fleet.get("migrations"):
+            lines.append("  migrations: " + "  ".join(
+                f"{r}={n}" for r, n in
+                sorted(fleet["migrations"].items())))
+        for host, n in sorted((fleet.get("recovered_hosts")
+                               or {}).items()):
+            lines.append(f"  recovered host {host}: streams re-homed "
+                         f"({n} sweep hit(s))")
 
     ctrl = report.get("control") or {}
     if ctrl.get("decisions") or ctrl.get("counts"):

@@ -56,8 +56,10 @@ from typing import Any, Dict, List, Optional
 from .metrics import counter
 from .trace import TRACER
 
-#: the controllers of engine/autotune.py, in the order README documents
-CONTROLLERS = ("repartition", "capacity", "admission", "reclaim")
+#: the controllers of engine/autotune.py (+ the fleet plane's movers:
+#: rebalancer, drain, recovery sweep), in the order README documents
+CONTROLLERS = ("repartition", "capacity", "admission", "reclaim",
+               "fleet")
 
 #: terminal-at-record outcomes vs measured-next-window outcomes
 RECORD_OUTCOMES = ("pending", "applied", "refused", "error")
@@ -69,8 +71,9 @@ MAX_DECISIONS = 256
 _DECISIONS = counter(
     "mrtpu_control_decisions_total",
     "automatic control-plane decisions (labels: controller="
-    "repartition|capacity|admission|reclaim, outcome) — counted once "
-    "at record time (pending/applied/refused/error) and once more "
+    "repartition|capacity|admission|reclaim|fleet, outcome) — counted "
+    "once at record time (pending/applied/refused/error) and once "
+    "more "
     "when the next control window measures a pending decision "
     "(improved/neutral/regressed), so outcome sums tell the whole "
     "story: total decisions AND how they turned out")

@@ -232,6 +232,15 @@ def cluster_status(store, now: Optional[float] = None,
         sched = scheduler.snapshot()
         if sched:
             out["sched"] = sched
+    # the engine-host fleet (coord/fleet): membership states, lease
+    # headroom, heartbeat facts and per-host stream routes — read from
+    # the board like every other section, so ANY process over the
+    # store renders it; empty (no host ever joined) stays off the page
+    from ..coord.fleet import fleet_snapshot  # late: coord pulls obs
+
+    fleet = fleet_snapshot(store, now=now)
+    if fleet:
+        out["fleet"] = fleet
     if collector is not None:
         out["telemetry"] = collector.summary()
     for db, colls in sorted(_dbnames(store).items()):

@@ -215,7 +215,8 @@ class Scheduler:
                  lease: Optional[SchedulerLease] = None,
                  use_lease: bool = True,
                  holder: Optional[str] = None,
-                 advisor: Optional[Any] = None) -> None:
+                 advisor: Optional[Any] = None,
+                 fleet: Optional[Any] = None) -> None:
         self.store = store
         self.config = config
         self.lease = lease if lease is not None else (
@@ -229,6 +230,15 @@ class Scheduler:
         #: admission decision).  None — the default — admits exactly
         #: as before.
         self.advisor = advisor
+        #: the engine-host fleet (coord/fleet.FleetRegistry): when
+        #: attached, every tick (lease-gated, so ONE sweeper
+        #: cluster-wide) mirrors live hosts' heartbeat facts into the
+        #: advisor, runs the failed-host recovery sweep (an expired
+        #: host's streams re-home to live hosts; lazy restore makes
+        #: them servable after one sweep), and an admitted task's mesh
+        #: pick lands in the fleet's task->host route table.  None —
+        #: the default — is the single-host scheduler bit-for-bit.
+        self.fleet = fleet
         self._lock = threading.Lock()
 
     # -- submit (admission control) ---------------------------------------
@@ -414,6 +424,18 @@ class Scheduler:
         """
         if not self._owns_admission(strict):
             return []
+        if self.fleet is not None:
+            # the fleet plane rides the SAME lease gate as admission:
+            # exactly one scheduler cluster-wide mirrors host facts
+            # into the advisor and sweeps for failed hosts — two
+            # sweepers racing a re-home would be resolved by the
+            # guarded route flips anyway, but one sweeper means one
+            # auditable decision per move, not one plus a raced no-op
+            try:
+                self.fleet.sync_advisor(self.advisor)
+                self.recovery_sweep()
+            except OSError:
+                pass  # board hiccup: next tick retries the sweep
         admitted: List[Dict[str, Any]] = []
         with self._lock:
             while True:
@@ -464,6 +486,13 @@ class Scheduler:
                                           {"_id": doc["_id"]},
                                           {"$set": {"mesh": mesh}})
                         doc["mesh"] = mesh
+                        if self.fleet is not None:
+                            # the pick is also a fleet ROUTE: the
+                            # task->host table is what drain and the
+                            # recovery sweep re-home, and the stored
+                            # program lets them score warmth later
+                            self.fleet.assign(doc["_id"], mesh,
+                                              program=program)
                 # queue wait (submit->admitted): exact monotonic when
                 # this process saw the submit, else the board's
                 # persisted stamps (cross-process degradation, the
@@ -486,6 +515,41 @@ class Scheduler:
             if admitted:
                 self._refresh_gauges()
         return admitted
+
+    # -- failed-host recovery (the fleet plane) ----------------------------
+
+    def recovery_sweep(self) -> List[tuple]:
+        """Notice expired host leases and re-home their streams: for
+        every host whose lease lapsed WITHOUT a clean release, move
+        each of its routed streams to the best live host (guarded
+        route flips, one control-ledger ``fleet`` decision per move —
+        :func:`~..coord.fleet.rehome_routes`), then reap the host doc
+        under a (holder, generation) guard so the sweep fires once and
+        a returning zombie fences instead of resurrecting re-homed
+        streams.  The streams themselves are durable in the spill
+        store and restore LAZILY on their new host's next touch, so a
+        dead host's whole tenancy is servable again after this one
+        sweep.  Returns the ``(task, dst_host)`` moves made."""
+        if self.fleet is None:
+            return []
+        from ..coord import fleet as _fleet
+        from ..obs import control as _control
+
+        moves: List[tuple] = []
+        now = docstore.now()
+        for doc in self.fleet.expired_hosts(now):
+            host_id = str(doc["_id"])
+            moves.extend(_fleet.rehome_routes(
+                self.fleet, host_id, reason="recovery",
+                ledger=_control.LEDGER, now=now))
+            if self.fleet.routes_for(host_id):
+                # no live destination took them (rehome recorded the
+                # refusal): leave the host EXPIRED so the next sweep
+                # retries — reaping now would orphan the routes
+                continue
+            if self.fleet.reap(doc):
+                _fleet._RECOVERIES.inc(host=host_id)
+        return moves
 
     # -- lifecycle transitions (runner-facing) -----------------------------
 

@@ -1,0 +1,65 @@
+"""The engine-host victim process for tests/test_fleet_chaos.py.
+
+Joins the fleet on the shared dir:// board under the host id the
+parent gave it, routes the ``live`` stream to itself, then feeds the
+deterministic record stream ONE chunk per iteration with a spill after
+every feed — each spill is a durable handoff point, so whenever the
+parent's SIGKILL lands (mid-feed, mid-spill, between), the last
+COMMITTED spill is the stream's authoritative state (a kill mid-spill
+leaves the previous manifest authoritative, engine/spill.py).  Feeds
+a finite stream then idles heartbeating; the parent's SIGKILL is the
+only way out — this module never exits cleanly on purpose.
+
+Run: python -m tests.fleet_chaos_child CONNSTR SPILL_DIR HOST_ID LEASE
+"""
+
+import sys
+import time
+
+
+def main() -> None:
+    connstr, spill_dir, host_id, lease = sys.argv[1:5]
+
+    import numpy as np
+
+    from mapreduce_tpu.coord import docstore
+    from mapreduce_tpu.coord.fleet import FleetMember, FleetRegistry
+    from mapreduce_tpu.engine.device_engine import EngineConfig
+    from mapreduce_tpu.engine.session import EngineSession
+    from mapreduce_tpu.engine.spill import SessionSpillStore
+    from mapreduce_tpu.parallel import make_mesh
+    from mapreduce_tpu.storage.localdir import LocalDirStorage
+    from tests.test_fused_engine import _chunks as _rec_chunks
+    from tests.test_fused_engine import _records_map_fn
+
+    store = docstore.connect(connstr)
+    member = FleetMember(store, host_id=host_id, lease=float(lease))
+    member.join(timeout=10.0, warm_programs=[], hbm_frac=0.2)
+    FleetRegistry(store).assign("live", host_id, program="records")
+
+    cfg = EngineConfig(local_capacity=256, exchange_capacity=128,
+                       out_capacity=256, tile=64, tile_records=64,
+                       reduce_op="sum")
+    chunks = _rec_chunks(np.random.default_rng(13), 48)
+    sess = EngineSession(
+        make_mesh(), _records_map_fn, cfg, task="live", k=1,
+        spill=SessionSpillStore(LocalDirStorage(spill_dir)))
+
+    for i in range(len(chunks)):
+        member.heartbeat(warm_programs=[], hbm_frac=0.2)
+        sess.feed(chunks[i:i + 1])
+        step = sess.spill_stream()
+        # progress ships AFTER the spill commits: the parent kills only
+        # once at least N spills are durable, but the spill META (pos)
+        # stays the authoritative fed-count — a kill can land between
+        # the spill and this write
+        store.update("__chaos__.progress", {"_id": host_id},
+                     {"$set": {"spilled_chunks": i + 1, "step": step}},
+                     upsert=True)
+    while True:                          # idle until SIGKILLed
+        member.heartbeat(warm_programs=[], hbm_frac=0.2)
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

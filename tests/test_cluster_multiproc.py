@@ -32,6 +32,12 @@ STRAGGLE_S = 0.35
 @pytest.fixture(autouse=True)
 def fresh_modules():
     spec.clear_caches()
+    # the driver pushes its process-global trace ring to the collector,
+    # so job spans left over from earlier in-process tests (other worker
+    # names, other latency profiles) would land in THIS clusterz doc and
+    # dilute the straggler baseline until wslow no longer stands out
+    from mapreduce_tpu.obs.trace import TRACER
+    TRACER.reset()
     yield
     spec.clear_caches()
 
@@ -40,10 +46,14 @@ def _spawn_worker(connstr, name, env):
     return subprocess.Popen(
         [sys.executable, "-m", "mapreduce_tpu.cli", "worker",
          connstr, "skw", "--name", name, "--max-iter", "400",
-         # claim-batch 1 keeps each job span a clean per-job
-         # claim->write interval (a batch's later jobs backdate to the
-         # batch claim, which is queueing, not execution)
-         "--claim-batch", "1", "--telemetry-interval", "0.1"],
+         # claim-batch 1 + no claim-ahead keep each job span a clean
+         # per-job claim->write interval: a batch's later jobs backdate
+         # to the batch claim, and a prefetched claim backdates to
+         # BEFORE the previous job finished — both are queueing, not
+         # execution, and both inflate the fast worker's median enough
+         # to mask the injected straggler under the ratio test
+         "--claim-batch", "1", "--no-claim-ahead",
+         "--telemetry-interval", "0.1"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
 
 

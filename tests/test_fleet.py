@@ -1,0 +1,520 @@
+"""Engine-host fleet suite (coord/fleet + engine/migrate + the
+scheduler's failed-host recovery sweep + the fleet rebalancer):
+membership lifecycle (join / heartbeat facts / drain flag / expiry /
+guarded reap / zombie fencing), the guarded task->host route table,
+live migration bit-identity (evict on A, lazy restore on B), the
+feed-races-migration retry-after contract, learned-partition-map
+carriage through a migration, failed-host recovery end to end, and
+the fleet surfaces (statusz section, status render, diagnose
+findings).
+
+Rides the shared synthetic record stream (tests/test_fused_engine's
+``_records_map_fn``) at test_session_spill's config/shape, so the
+whole suite reuses wave programs other suites already compiled."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mapreduce_tpu.coord import docstore
+from mapreduce_tpu.coord.fleet import (
+    DEFAULT_HOST_LEASE, FleetMember, FleetRegistry, HostFencedError,
+    default_host_id, fleet_snapshot, host_state, rehome_routes)
+from mapreduce_tpu.engine.autotune import AdmissionAdvisor, FleetRebalancer
+from mapreduce_tpu.engine.device_engine import EngineConfig
+from mapreduce_tpu.engine.migrate import migrate
+from mapreduce_tpu.engine.session import (
+    EngineSession, SessionBusyError, SessionStreamBroken)
+from mapreduce_tpu.engine.spill import SessionSpillStore
+from mapreduce_tpu.obs import control as _control
+from mapreduce_tpu.obs.metrics import REGISTRY
+from mapreduce_tpu.parallel import make_mesh
+from mapreduce_tpu.storage.memory import MemoryStorage
+from tests.test_fused_engine import _chunks as _rec_chunks
+from tests.test_fused_engine import _records_map_fn
+
+CFG = EngineConfig(local_capacity=256, exchange_capacity=128,
+                   out_capacity=256, tile=64, tile_records=64,
+                   reduce_op="sum")
+
+
+def _chunks(s=32, seed=7):
+    return _rec_chunks(np.random.default_rng(seed), s)
+
+
+def _snap_equal(a, b):
+    for f in ("keys", "values", "payload", "valid"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
+def _session(mesh, store=None, task="t", k=1, **kw):
+    return EngineSession(mesh, _records_map_fn, CFG, task=task, k=k,
+                         spill=store, **kw)
+
+
+# -- membership --------------------------------------------------------------
+
+
+def test_membership_lifecycle_drain_reap_and_fence():
+    """join -> live; drain flag rides the heartbeat post-image; clean
+    leave -> left; missed beats -> expired; reap is guarded (fires
+    once) and fences the zombie's next beat definitively; a rejoin
+    bumps the fencing generation."""
+    board = docstore.connect("mem://fleet-lifecycle")
+    a = FleetMember(board, host_id="hostA", lease=0.4)
+    b = FleetMember(board, host_id="hostB")
+    gen_a = a.join(timeout=2.0, warm_programs=["wc"], hbm_frac=0.3)
+    b.join(timeout=2.0)
+    reg = FleetRegistry(board)
+    now = docstore.now()
+    states = {str(d["_id"]): host_state(d, now) for d in reg.hosts()}
+    assert states == {"hostA": "live", "hostB": "live"}
+
+    # the drain request comes back on the NEXT heartbeat's post-image
+    assert reg.request_drain("hostA")
+    doc = a.heartbeat(warm_programs=["wc"], hbm_frac=0.3)
+    assert doc is not None and doc.get("drain") is True
+    assert host_state(doc, docstore.now()) == "draining"
+    # draining hosts still count as live members (they serve until
+    # their drain completes) but never as re-home destinations
+    assert {str(d["_id"]) for d in reg.live_hosts()} == \
+        {"hostA", "hostB"}
+
+    assert b.leave()
+    doc_b = next(d for d in reg.hosts() if d["_id"] == "hostB")
+    assert host_state(doc_b, docstore.now()) == "left"
+
+    time.sleep(0.5)                     # hostA misses its beats
+    expired = reg.expired_hosts()
+    assert [d["_id"] for d in expired] == ["hostA"]
+    assert reg.reap(expired[0])
+    assert not reg.reap(expired[0])     # guarded: fires exactly once
+    assert a.heartbeat() is None        # zombie: DEFINITIVE loss
+    with pytest.raises(HostFencedError):
+        a.ensure_member()
+    assert a.join(timeout=2.0) > gen_a  # rejoin under a new generation
+
+
+def test_routes_are_guarded():
+    """reroute() wins only while the route still points at the
+    expected source — a raced mover resolves to exactly one flip."""
+    board = docstore.connect("mem://fleet-routes")
+    reg = FleetRegistry(board)
+    reg.assign("t", "hostA", program="wc")
+    assert not reg.reroute("t", "hostB", expect_src="hostC")
+    assert reg.route("t")["host"] == "hostA"
+    assert reg.reroute("t", "hostB", expect_src="hostA")
+    assert reg.route("t")["host"] == "hostB"
+    assert reg.route("t")["program"] == "wc"
+    reg.drop_route("t")
+    assert reg.route("t") is None
+
+
+def test_advisor_sync_mirrors_fleet_membership():
+    """Live hosts' heartbeat facts register under their host id; a
+    reaped host unregisters; an embedder's own mesh is left alone."""
+    board = docstore.connect("mem://fleet-advisor")
+    a = FleetMember(board, host_id="hostA", lease=0.4)
+    a.join(timeout=2.0, warm_programs=["wc"], hbm_frac=0.3)
+    reg = FleetRegistry(board)
+    adv = AdmissionAdvisor()
+    adv.register_mesh("embedder", warm_programs=["x"], hbm_frac=None)
+    reg.sync_advisor(adv)
+    assert set(adv._meshes) == {"embedder", "hostA"}
+    time.sleep(0.5)
+    reg.reap(reg.expired_hosts()[0])
+    reg.sync_advisor(adv)
+    assert set(adv._meshes) == {"embedder"}
+
+
+def test_default_host_id_is_process_unique():
+    hid = default_host_id()
+    assert ":" in hid and hid.rsplit(":", 1)[1].isdigit()
+
+
+# -- live migration ----------------------------------------------------------
+
+
+def test_migration_bit_identical_and_registry_routed():
+    """migrate(task, A, B): evict on the source, guarded route flip,
+    lazy restore on the destination — the destination's final snapshot
+    is BIT-identical to an uninterrupted stream, the source refuses
+    with retry-after semantics, and the move is counted + ledgered."""
+    chunks = _chunks()
+    half = len(chunks) // 2
+    mesh = make_mesh()
+
+    ref_s = _session(mesh, task="ref")
+    ref_s.feed(chunks[:half])
+    ref_s.feed(chunks[half:])
+    ref = ref_s.snapshot()
+
+    board = docstore.connect("mem://fleet-migrate")
+    a = FleetMember(board, host_id="hostA")
+    b = FleetMember(board, host_id="hostB")
+    a.join(timeout=2.0)
+    b.join(timeout=2.0)
+    reg = FleetRegistry(board)
+    reg.assign("t", "hostA", program="p")
+
+    store = SessionSpillStore(MemoryStorage())
+    src = _session(mesh, store)
+    dst = _session(mesh, store)
+    src.feed(chunks[:half])
+
+    m0 = REGISTRY.sum("mrtpu_session_migrations_total", task="t",
+                      reason="explicit")
+    d0 = len(_control.LEDGER.decisions(controller="fleet"))
+    res = migrate("t", src, dst, registry=reg, src_host="hostA",
+                  dst_host="hostB", reason="explicit")
+    assert res["routed"] and res["step"] is not None
+    assert reg.route("t")["host"] == "hostB"
+    assert REGISTRY.sum("mrtpu_session_migrations_total", task="t",
+                        reason="explicit") - m0 == 1
+    decs = _control.LEDGER.decisions(controller="fleet")
+    assert len(decs) == d0 + 1
+    assert "hostA -> hostB" in decs[-1]["note"]
+    assert decs[-1]["outcome"] == "applied"
+
+    # the source half: retry-after, never a stream-death signal
+    with pytest.raises(SessionBusyError):
+        src.feed(chunks[half:])
+    with pytest.raises(SessionBusyError):
+        src.snapshot("t")
+    # the destination half: lazy restore on the next feed, bit-exact
+    dst.feed(chunks[half:])
+    _snap_equal(dst.snapshot(), ref)
+    ref_s.close(), src.close(), dst.close()
+
+
+def test_migrate_back_lifts_the_handoff_refusal():
+    """A->B->A round trip: migrating a stream BACK to a former source
+    must lift that session's handed-off mark (migrate calls
+    dst.adopt), and the values stay exact."""
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    ref_s = _session(mesh, task="ref")
+    ref_s.feed(chunks)
+    ref = ref_s.snapshot()
+
+    store = SessionSpillStore(MemoryStorage())
+    sa, sb = _session(mesh, store), _session(mesh, store)
+    sa.feed(chunks[:8])
+    migrate("t", sa, sb)
+    sb.feed(chunks[8:])
+    migrate("t", sb, sa)
+    _snap_equal(sa.snapshot(), ref)     # adopted back: serves again
+    ref_s.close(), sa.close(), sb.close()
+
+
+def test_feed_racing_migration_gets_retry_after_not_broken():
+    """A feed that arrives MID-evict (blocked on the session lock
+    while migrate_out spills) is refused with SessionBusyError —
+    retry-after at the new route — never SessionStreamBroken, and the
+    refusal is counted under the ``migrating`` backpressure reason.
+    The destination then serves a snapshot bit-identical to an
+    uninterrupted stream."""
+    chunks = _chunks()
+    half = len(chunks) // 2
+    mesh = make_mesh()
+    ref_s = _session(mesh, task="ref")
+    ref_s.feed(chunks[:half])
+    ref_s.feed(chunks[half:])
+    ref = ref_s.snapshot()
+
+    store = SessionSpillStore(MemoryStorage())
+    s = _session(mesh, store)
+    s.feed(chunks[:half])
+
+    entered = threading.Event()
+    orig = store.save_stream
+
+    def slow_save(*a, **k):
+        entered.set()
+        time.sleep(0.2)                 # hold the evict open
+        return orig(*a, **k)
+
+    store.save_stream = slow_save       # type: ignore[assignment]
+    b0 = REGISTRY.sum("mrtpu_session_backpressure_total", task="t",
+                      reason="migrating")
+    t = threading.Thread(target=s.migrate_out, args=("t",))
+    t.start()
+    assert entered.wait(10)             # the evict is in flight NOW
+    try:
+        with pytest.raises(SessionBusyError) as exc:
+            s.feed(chunks[half:])       # racing feed: waits, refused
+        assert not isinstance(exc.value, SessionStreamBroken)
+    finally:
+        t.join(timeout=30)
+        store.save_stream = orig        # type: ignore[assignment]
+    assert REGISTRY.sum("mrtpu_session_backpressure_total", task="t",
+                        reason="migrating") - b0 == 1
+
+    dst = _session(mesh, store)
+    dst.feed(chunks[half:])             # lazy restore + the rest
+    _snap_equal(dst.snapshot(), ref)
+    ref_s.close(), s.close(), dst.close()
+
+
+def test_partition_map_survives_same_topology_migration():
+    """A stream's LEARNED bucket->partition table travels in the spill
+    meta: after a same-device-count migration the destination folds
+    under the same map (rebalances counter carried, not reset) and the
+    final snapshot is bit-identical to an uninterrupted rebalanced
+    stream.  Only a genuinely different device count resets to
+    identity (tests/test_session_spill's resharded-restore pin)."""
+    from mapreduce_tpu.engine.device_engine import identity_pmap
+
+    mesh = make_mesh()
+    n_dev = mesh.shape["data"]
+    cfg = EngineConfig(local_capacity=256, exchange_capacity=64,
+                       out_capacity=256, reduce_op="sum",
+                       partition_map=True)
+    chunks = _rec_chunks(np.random.default_rng(37), 4 * n_dev)
+    half = chunks.shape[0] // 2
+    pm = None
+
+    def _mk(store=None):
+        return EngineSession(mesh, _records_map_fn, cfg, task="t", k=2,
+                             spill=store)
+
+    ref_s = _mk()
+    ref_s.feed(chunks[:0])              # latch the shape
+    pm = (identity_pmap(ref_s.engine.partition_buckets, n_dev)
+          + 3) % n_dev                  # every bucket moves
+    ref_s.rebalance("t", pm)
+    ref_s.feed(chunks[:half])
+    ref_s.feed(chunks[half:])
+    ref = ref_s.snapshot()
+
+    store = SessionSpillStore(MemoryStorage())
+    src = _mk(store)
+    src.feed(chunks[:0])
+    src.rebalance("t", pm)
+    src.feed(chunks[:half])
+    dst = _mk(store)
+    migrate("t", src, dst)
+    dst.feed(chunks[half:])             # restore must carry the map
+    assert dst.stats("t")["rebalances"] == 1
+    _snap_equal(dst.snapshot(), ref)
+    ref_s.close(), src.close(), dst.close()
+
+
+# -- failed-host recovery ----------------------------------------------------
+
+
+def test_recovery_sweep_rehomes_dead_hosts_streams():
+    """SIGKILL semantics in-process: hostA stops heartbeating with two
+    spilled streams; one scheduler sweep re-homes them to the live
+    host, reaps hostA under guard, and the streams are servable from
+    the new host via lazy restore — snapshots equal the last spilled
+    state."""
+    from mapreduce_tpu.sched.scheduler import Scheduler
+
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    board = docstore.connect("mem://fleet-recovery")
+    a = FleetMember(board, host_id="hostA", lease=0.4)
+    b = FleetMember(board, host_id="hostB")
+    a.join(timeout=2.0)
+    b.join(timeout=2.0, warm_programs=[], hbm_frac=0.2)
+    reg = FleetRegistry(board)
+
+    store = SessionSpillStore(MemoryStorage())
+    sa = _session(mesh, store, task="t1")
+    sa.feed(chunks, task="t1")
+    sa.feed(chunks, task="t2")
+    ref1, ref2 = sa.snapshot("t1"), sa.snapshot("t2")
+    sa.spill_stream("t1")
+    sa.spill_stream("t2")
+    reg.assign("t1", "hostA")
+    reg.assign("t2", "hostA")
+    # hostA now "dies": no close, no leave — just no more heartbeats
+    time.sleep(0.5)
+
+    sched = Scheduler(board, use_lease=False,
+                      advisor=AdmissionAdvisor(), fleet=reg)
+    r0 = REGISTRY.sum("mrtpu_fleet_recoveries_total", host="hostA")
+    moves = sched.recovery_sweep()
+    assert sorted(moves) == [("t1", "hostB"), ("t2", "hostB")]
+    assert REGISTRY.sum("mrtpu_fleet_recoveries_total",
+                        host="hostA") - r0 == 1
+    doc_a = next(d for d in reg.hosts() if d["_id"] == "hostA")
+    assert host_state(doc_a, docstore.now()) == "left"   # reaped
+    assert a.heartbeat() is None        # zombie fences
+    assert sched.recovery_sweep() == []  # idempotent: nothing left
+
+    sb = _session(mesh, store)          # the new host, same store
+    _snap_equal(sb.snapshot("t1"), ref1)
+    _snap_equal(sb.snapshot("t2"), ref2)
+    sa.close(drop_spill=False), sb.close()
+
+
+def test_recovery_defers_with_no_live_destination():
+    """Zero live hosts: the sweep records ONE refused decision, leaves
+    the dead host expired (reaping would orphan its routes), and the
+    next sweep — with a live host back — completes the re-home."""
+    from mapreduce_tpu.sched.scheduler import Scheduler
+
+    board = docstore.connect("mem://fleet-defer")
+    a = FleetMember(board, host_id="hostA", lease=0.3)
+    a.join(timeout=2.0)
+    reg = FleetRegistry(board)
+    reg.assign("t", "hostA")
+    time.sleep(0.4)
+
+    sched = Scheduler(board, use_lease=False, fleet=reg)
+    d0 = len(_control.LEDGER.decisions(controller="fleet"))
+    assert sched.recovery_sweep() == []
+    assert reg.route("t")["host"] == "hostA"     # still routed there
+    assert [d["_id"] for d in reg.expired_hosts()] == ["hostA"]
+    decs = _control.LEDGER.decisions(controller="fleet")
+    assert len(decs) == d0 + 1 and decs[-1]["outcome"] == "refused"
+
+    b = FleetMember(board, host_id="hostB")
+    b.join(timeout=2.0)
+    assert sched.recovery_sweep() == [("t", "hostB")]
+    doc_a = next(d for d in reg.hosts() if d["_id"] == "hostA")
+    assert host_state(doc_a, docstore.now()) == "left"
+
+
+# -- the rebalance controller ------------------------------------------------
+
+
+def test_rebalancer_moves_coldest_stream_off_hot_host():
+    """HBM pressure on hostA (heartbeat facts): one control window
+    migrates its COLDEST stream to the host with headroom, the move is
+    an auditable fleet decision with the pressure evidence, and the
+    destination serves the stream's exact values."""
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    board = docstore.connect("mem://fleet-rebalance")
+    a = FleetMember(board, host_id="hostA")
+    b = FleetMember(board, host_id="hostB")
+    a.join(timeout=2.0, warm_programs=[], hbm_frac=0.95)
+    b.join(timeout=2.0, warm_programs=[], hbm_frac=0.10)
+    reg = FleetRegistry(board)
+
+    store = SessionSpillStore(MemoryStorage())
+    sa = _session(mesh, store, task="cold")
+    sb = _session(mesh, store, task="cold")
+    sa.feed(chunks, task="cold")
+    ref_cold = sa.snapshot("cold")
+    time.sleep(0.01)
+    sa.feed(chunks, task="hot")         # newer touch: stays put
+    reg.assign("cold", "hostA")
+    reg.assign("hot", "hostA")
+
+    rb = FleetRebalancer(reg)
+    d0 = len(_control.LEDGER.decisions(controller="fleet"))
+    moves = rb.step({"hostA": sa, "hostB": sb})
+    assert moves == [("cold", "hostB")]
+    assert reg.route("cold")["host"] == "hostB"
+    assert reg.route("hot")["host"] == "hostA"
+    decs = _control.LEDGER.decisions(controller="fleet")
+    assert len(decs) == d0 + 1
+    ev = decs[-1]["evidence"]
+    assert ev["hbm_frac"] == 0.95 and "candidates" in ev
+    with pytest.raises(SessionBusyError):
+        sa.feed(chunks, task="cold")    # handed off
+    _snap_equal(sb.snapshot("cold"), ref_cold)
+    sa.close(), sb.close()
+
+
+def test_rebalancer_refusal_is_memoized_not_spam():
+    """A hot host with nowhere to move records ONE refused decision,
+    not one per control window."""
+    chunks = _chunks(16)
+    mesh = make_mesh()
+    board = docstore.connect("mem://fleet-refuse")
+    a = FleetMember(board, host_id="hostA")
+    a.join(timeout=2.0, warm_programs=[], hbm_frac=0.9)
+    reg = FleetRegistry(board)
+    store = SessionSpillStore(MemoryStorage())
+    sa = _session(mesh, store)
+    sa.feed(chunks)
+    reg.assign("t", "hostA")
+
+    rb = FleetRebalancer(reg)
+    d0 = len(_control.LEDGER.decisions(controller="fleet"))
+    assert rb.step({"hostA": sa}) == []
+    assert rb.step({"hostA": sa}) == []
+    decs = _control.LEDGER.decisions(controller="fleet")
+    assert len(decs) == d0 + 1 and decs[-1]["outcome"] == "refused"
+    sa.close()
+
+
+# -- surfaces: statusz, status render, diagnose ------------------------------
+
+
+def test_statusz_fleet_section_and_render():
+    """cluster_status grows a fleet section when hosts exist (off the
+    page otherwise), the status CLI renders it, and the host-state
+    gauge family is refreshed whole at snapshot time."""
+    from mapreduce_tpu import cli
+    from mapreduce_tpu.obs.statusz import cluster_status
+
+    board = docstore.connect("mem://fleet-statusz")
+    assert "fleet" not in cluster_status(board)     # empty: no section
+    a = FleetMember(board, host_id="hostA")
+    a.join(timeout=2.0, warm_programs=["wc"], hbm_frac=0.4)
+    FleetRegistry(board).assign("t", "hostA")
+    snap = cluster_status(board)
+    fl = snap["fleet"]
+    assert fl["hosts"]["hostA"]["state"] == "live"
+    assert fl["hosts"]["hostA"]["streams"] == 1
+    assert fl["routes"] == 1
+    assert REGISTRY.sum("mrtpu_fleet_hosts", state="live") >= 1
+    lines = cli._render_fleet(fl)
+    assert lines and "hostA" in "\n".join(lines)
+    assert "LIVE" in "\n".join(lines)
+
+
+def test_diagnose_surfaces_fleet_findings():
+    """A /clusterz document's fleet counters become report["fleet"]
+    plus operator notes, and render_diagnosis shows the section."""
+    from mapreduce_tpu.obs.analysis import diagnose, render_diagnosis
+
+    doc = {"mrtpuCluster": {"metrics": [
+        ["mrtpu_session_migrations_total",
+         {"task": "t", "reason": "recovery"}, 2],
+        ["mrtpu_session_migrations_total",
+         {"task": "u", "reason": "rebalance"}, 1],
+        ["mrtpu_fleet_recoveries_total", {"host": "hostA"}, 1],
+        ["mrtpu_fleet_hosts", {"state": "live"}, 2],
+        ["mrtpu_fleet_hosts", {"state": "expired"}, 1],
+    ]}}
+    report = diagnose(doc)
+    fl = report["fleet"]
+    assert fl["migrations"] == {"recovery": 2, "rebalance": 1}
+    assert fl["recovered_hosts"] == {"hostA": 1}
+    assert fl["hosts"] == {"live": 2, "expired": 1}
+    notes = "\n".join(report["notes"])
+    assert "3 stream migration(s)" in notes
+    assert "host hostA died" in notes
+    assert "expired lease" in notes
+    text = render_diagnosis(report)
+    assert "engine fleet:" in text and "recovered host hostA" in text
+
+
+def test_rehome_prefers_warm_host_with_headroom():
+    """The re-home destination score is the admission score over
+    heartbeat facts: warmth for the route's recorded program beats a
+    cold host, pressure disqualifies."""
+    board = docstore.connect("mem://fleet-score")
+    for hid, warm, frac in (("cold", [], 0.1),
+                            ("warm", ["wc"], 0.5),
+                            ("hot", ["wc"], 0.95)):
+        m = FleetMember(board, host_id=hid)
+        m.join(timeout=2.0, warm_programs=warm, hbm_frac=frac)
+    reg = FleetRegistry(board)
+    reg.assign("t", "dead", program="wc")
+    moves = rehome_routes(reg, "dead", reason="recovery")
+    assert moves == [("t", "warm")]
+
+
+def test_default_host_lease_is_the_detection_window():
+    assert 0 < DEFAULT_HOST_LEASE <= 10.0

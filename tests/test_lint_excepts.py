@@ -225,6 +225,13 @@ _MONOTONIC_ONLY_MODULES = {
     # reclaimer does are minted/read through coord/docstore.now)
     os.path.join("mapreduce_tpu", "obs", "control.py"),
     os.path.join("mapreduce_tpu", "engine", "autotune.py"),
+    # the engine-host fleet plane: lease waits and migration stages
+    # are durations, and every persisted stamp (host lease expiry,
+    # heartbeat facts age, route moves) is minted through
+    # coord/docstore.now — a steppable clock in the membership
+    # arithmetic would flap liveness and mis-time migrations
+    os.path.join("mapreduce_tpu", "coord", "fleet.py"),
+    os.path.join("mapreduce_tpu", "engine", "migrate.py"),
     # the Pallas hot-path plane: the kernel modules and the shared
     # compat layer sit INSIDE traced wave programs — they must read no
     # clocks at all (a clock read at trace time would bake a constant
