@@ -1562,8 +1562,78 @@ def check_smoke() -> int:
         pusher.stop(flush=False)
         srv.shutdown()
 
+    # durable-history gate (obs/history): one live docserver with the
+    # history plane attached.  Every assertion reads the metrics
+    # registry or the /queryz wire — never a wall clock — so it cannot
+    # flake on load: append overhead is bounded per push batch, the
+    # /queryz increase of a probe counter must match the registry's
+    # cumulative value BIT-EXACTLY (first-entry delta carries the full
+    # cumulative, so total increase == final cum), and a corrupt
+    # segment must refuse to load rather than serve wrong numbers.
+    import shutil
+    import tempfile
+
+    from mapreduce_tpu.obs.history import (HistoryCorruptError,
+                                           MetricHistory)
+    from mapreduce_tpu.obs.metrics import counter
+
+    hist_dir = tempfile.mkdtemp(prefix="bench-history-")
+    probe = counter("mrtpu_bench_history_probe_total",
+                    "bench-only durable-history smoke probe")
+    a0 = REGISTRY.sum("mrtpu_history_appends_total")
+    o0 = REGISTRY.sum("mrtpu_history_append_seconds")
+    hp0 = REGISTRY.sum("mrtpu_telemetry_pushes_total")
+    srv = DocServer(history_dir=hist_dir).start_background()
+    pusher = TelemetryPusher(f"{srv.host}:{srv.port}",
+                             role="bench-history", interval=60.0)
+    try:
+        assert pusher.flush(), "history-plane telemetry push failed"
+        probe.inc(7)
+        assert pusher.flush(), "history-plane telemetry push failed"
+        hist_pushes = REGISTRY.sum("mrtpu_telemetry_pushes_total") - hp0
+        hist_appends = REGISTRY.sum("mrtpu_history_appends_total") - a0
+        assert 1 <= hist_appends <= hist_pushes, (
+            f"history append overhead unbounded: {hist_appends} "
+            f"appends for {hist_pushes} push batches (expected at "
+            "most one append per push)")
+        observed = REGISTRY.sum("mrtpu_history_append_seconds") - o0
+        assert observed >= hist_appends, (
+            "append latency histogram missed appends "
+            f"({observed} observations, {hist_appends} appends)")
+        client = HttpDocStore(f"{srv.host}:{srv.port}")
+        try:
+            res = client.queryz(
+                {"metric": "mrtpu_bench_history_probe_total",
+                 "fn": "increase", "start": -3600})
+        finally:
+            client.close()
+        hist_got = sum(v for s in res["series"]
+                       for _t, v in s["points"])
+        want = REGISTRY.sum("mrtpu_bench_history_probe_total")
+        assert hist_got == want, (
+            f"/queryz increase diverged from the registry: history "
+            f"says {hist_got}, registry says {want}")
+    finally:
+        pusher.stop(flush=False)
+        srv.shutdown()
+    bad_dir = tempfile.mkdtemp(prefix="bench-history-bad-")
+    with open(os.path.join(bad_dir, "seg-00000001.jsonl"), "w") as f:
+        f.write('{"v":1,"garbled":true}\n')
+    try:
+        MetricHistory(bad_dir).load()
+    except HistoryCorruptError:
+        pass
+    else:
+        raise AssertionError("a corrupt history segment loaded "
+                             "silently instead of refusing")
+    shutil.rmtree(hist_dir, ignore_errors=True)
+    shutil.rmtree(bad_dir, ignore_errors=True)
+
     print(json.dumps({
         "mode": "check_smoke", "ok": True,
+        "history_gate": {"appends": hist_appends,
+                         "queryz_increase": hist_got,
+                         "corrupt_refused": True},
         "history_runs": len(history),
         "gate_flagged_2x": bad_probs,
         "dispatches_per_wave": dispatches / waves_ran,

@@ -719,6 +719,27 @@ def cmd_docserver(argv: List[str]) -> int:
     g.add_argument("--tenant-max-queued-tasks", type=int, default=None)
     g.add_argument("--tenant-max-queued-jobs", type=int, default=None)
     g.add_argument("--tenant-max-queued-bytes", type=int, default=None)
+    th = p.add_argument_group(
+        "telemetry history (obs/history.py: every collector push "
+        "appends delta-encoded samples to seq-stamped JSONL segments; "
+        "/queryz + `cli history`/`cli top` read them back; defaults "
+        "onto <ha-dir>/history under HA so a promoted standby keeps "
+        "serving the series)")
+    th.add_argument("--history-dir", default=None,
+                    help="segment directory for the durable metric "
+                         "history (implied under --ha-dir; omit both "
+                         "to disable history)")
+    th.add_argument("--history-keep", type=int, default=None,
+                    metavar="N",
+                    help="segments retained after rotation (default 8)")
+    th.add_argument("--history-segment-bytes", type=int, default=None,
+                    metavar="B",
+                    help="rotate the active segment past this size "
+                         "(default 1000000)")
+    th.add_argument("--history-max-age", type=float, default=None,
+                    metavar="S",
+                    help="rotate the active segment past this age "
+                         "(default 300s)")
     _add_slo(p)
     _add_auth(p)
     _add_verbosity(p)
@@ -746,12 +767,18 @@ def cmd_docserver(argv: List[str]) -> int:
                     scheduler_config=(SchedulerConfig(**overrides)
                                       if overrides else None),
                     ha_dir=args.ha_dir, ha_lease=args.ha_lease,
-                    ha_fsync=args.ha_fsync)
+                    ha_fsync=args.ha_fsync,
+                    history_dir=args.history_dir,
+                    history_keep=args.history_keep,
+                    history_segment_bytes=args.history_segment_bytes,
+                    history_max_age_s=args.history_max_age)
     role = f"; HA role: {srv.ha.role}" if srv.ha is not None else ""
+    hist = (f", durable history at /queryz ({srv.history.dir})"
+            if srv.history is not None else "")
     print(f"job board at http://{srv.host}:{srv.port} "
           f"(CONNSTR: \"http://HOST:{srv.port}\"; Prometheus at "
           f"/metrics, cluster snapshot at /statusz, merged cluster "
-          f"timeline at /clusterz{role})", flush=True)
+          f"timeline at /clusterz{hist}{role})", flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -1046,6 +1073,25 @@ def _render_telemetry(tele: dict) -> List[str]:
     return lines
 
 
+def _render_history(hist: dict) -> List[str]:
+    """The durable-history row of /statusz (obs/history): segment and
+    series counts plus the covered wall-time span."""
+    if not hist:
+        return []
+    if hist.get("error"):
+        return [f"history: ERROR {hist['error']}"]
+    span = ""
+    oldest, newest = hist.get("oldest_t"), hist.get("newest_t")
+    if oldest is not None and newest is not None:
+        span = f", {newest - oldest:.0f}s span"
+    return ["history: {} segment(s), {} B, {} entr(ies), {} series "
+            "from {} proc(s){} (keep {})".format(
+                hist.get("segments", 0), hist.get("bytes", 0),
+                hist.get("entries", 0), hist.get("series", 0),
+                hist.get("procs", 0), span,
+                hist.get("keep_segments", "?"))]
+
+
 def _render_checkpoint(ck: dict) -> List[str]:
     """The training-plane section of /statusz: checkpoint save/restore/
     corruption counters and the last recovery time (obs/statusz
@@ -1099,6 +1145,7 @@ def render_status(snap: dict) -> str:
     lines += _render_slo(snap.get("slo") or {})
     lines += _render_control(snap.get("control") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
+    lines += _render_history(snap.get("history") or {})
     tasks = snap.get("tasks", {})
     if not tasks:
         lines.append("no tasks on this board")
@@ -1430,6 +1477,136 @@ def cmd_diagnose(argv: List[str]) -> int:
         print(json.dumps(report, indent=2, default=float))
     else:
         sys.stdout.write(analysis.render_diagnosis(report))
+    return 0
+
+
+def cmd_history(argv: List[str]) -> int:
+    """Range-query the docserver's durable telemetry history
+    (/queryz): one metric family, optional label matchers, a trailing
+    window, and a server-side fn (raw samples or aligned
+    rate/increase/delta series)."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu history")
+    p.add_argument("connstr",
+                   help="the docserver, http://HOST:PORT")
+    p.add_argument("--metric", required=True, metavar="FAMILY",
+                   help="metric family, e.g. mrtpu_records_total")
+    p.add_argument("--label", action="append", default=[],
+                   metavar="K=V",
+                   help="label matcher (repeatable), e.g. task=wc")
+    p.add_argument("--range", type=float, default=600.0, dest="range_s",
+                   metavar="S",
+                   help="trailing window in seconds (default 600)")
+    p.add_argument("--step", type=float, default=None, metavar="S",
+                   help="step-align rate/increase/delta series to S "
+                        "second buckets")
+    p.add_argument("--fn", default="increase",
+                   choices=("raw", "rate", "increase", "delta"),
+                   help="server-side function (default increase)")
+    p.add_argument("--by-proc", action="store_true", dest="by_proc",
+                   help="split counter series per pushing process")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the raw /queryz response as JSON")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    for m in args.label:
+        if "=" not in m:
+            print(f"bad --label {m!r} (want K=V)", file=sys.stderr)
+            return 2
+    store = _docserver_client(args.connstr, args.auth, "history")
+    if store is None:
+        return 2
+    params: dict = {"metric": args.metric, "fn": args.fn,
+                    "start": -abs(args.range_s)}
+    if args.label:
+        params["match"] = list(args.label)
+    if args.step is not None:
+        params["step"] = args.step
+    if args.by_proc:
+        params["by_proc"] = 1
+    try:
+        doc = store.queryz(params)
+    except PermissionError as exc:
+        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"cannot query {args.connstr}: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        store.close()
+    if args.as_json:
+        print(json.dumps(doc, indent=2, default=float))
+        return 0
+    series = doc.get("series") or []
+    print(f"{doc.get('metric')} [{doc.get('kind')}] fn={doc.get('fn')} "
+          f"window {doc.get('start')}..{doc.get('end')}"
+          + (f" step {doc.get('step')}s" if doc.get("step") else ""))
+    if not series:
+        print("  (no samples in range — is the history plane enabled "
+              "on the docserver, and did anything push?)")
+        return 0
+    for s in series:
+        labels = ",".join(f"{k}={v}"
+                          for k, v in sorted(s["labels"].items()))
+        pts = s.get("points") or []
+        print(f"  {{{labels}}}: {len(pts)} point(s)")
+        for t, v in pts:
+            print(f"    {t:.3f}  {v:g}")
+    return 0
+
+
+def cmd_top(argv: List[str]) -> int:
+    """Top-K busiest counter series by increase over a trailing
+    history window (/queryz op=top) — a quick 'what is this cluster
+    doing right now' for operators."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu top")
+    p.add_argument("connstr",
+                   help="the docserver, http://HOST:PORT")
+    p.add_argument("--k", type=int, default=10,
+                   help="how many series (default 10)")
+    p.add_argument("--window", type=float, default=300.0, metavar="S",
+                   help="trailing window in seconds (default 300)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the raw /queryz response as JSON")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    store = _docserver_client(args.connstr, args.auth, "top")
+    if store is None:
+        return 2
+    try:
+        doc = store.queryz({"op": "top", "k": args.k,
+                            "window": args.window})
+    except PermissionError as exc:
+        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"cannot query {args.connstr}: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        store.close()
+    if args.as_json:
+        print(json.dumps(doc, indent=2, default=float))
+        return 0
+    rows = doc.get("series") or []
+    print(f"top {len(rows)} counter series over the last "
+          f"{doc.get('window_s', args.window):g}s:")
+    if not rows:
+        print("  (nothing moved — or the history plane is not enabled "
+              "on this docserver)")
+    for r in rows:
+        labels = ",".join(f"{k}={v}"
+                          for k, v in sorted((r.get("labels")
+                                              or {}).items()))
+        print("  {:>12.6g}/s  +{:<10g} {}{}".format(
+            r.get("rate", 0.0), r.get("increase", 0.0), r.get("name"),
+            f"{{{labels}}}" if labels else ""))
     return 0
 
 
@@ -1957,7 +2134,8 @@ COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "profile": cmd_profile, "timeline": cmd_timeline,
             "diagnose": cmd_diagnose, "train": cmd_train,
             "submit": cmd_submit, "tasks": cmd_tasks,
-            "runner": cmd_runner, "drain": cmd_drain}
+            "runner": cmd_runner, "drain": cmd_drain,
+            "history": cmd_history, "top": cmd_top}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

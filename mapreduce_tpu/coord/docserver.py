@@ -38,8 +38,10 @@ import contextlib
 import http.server
 import itertools
 import json
+import os
 import threading
 import time
+import urllib.parse
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -489,11 +491,14 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         /healthz is open — it returns a static liveness body and nothing
         else, and orchestrator probes (k8s httpGet, load balancers)
         cannot send a bearer token."""
-        if self.path not in ("/metrics", "/statusz", "/tracez",
-                             "/clusterz", "/healthz", "/tasks"):
+        # /queryz carries its parameters in the query string; every
+        # other endpoint ignores one (exact-path matching on the split)
+        path, _, query = self.path.partition("?")
+        if path not in ("/metrics", "/statusz", "/tracez",
+                        "/clusterz", "/healthz", "/tasks", "/queryz"):
             return self._respond(404, b"{}")
-        if self.path == "/healthz":
-            _SCRAPES.inc(path=self.path)
+        if path == "/healthz":
+            _SCRAPES.inc(path=path)
             if self.ha is not None:
                 # liveness plus ROLE: orchestrator probes and the chaos
                 # suite can tell the primary from a standby without auth
@@ -503,9 +508,9 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             return self._respond(200, b'{"ok": true}')
         if not check_auth(self.auth_token, self.headers):
             return self._respond(401, b"{}")
-        _SCRAPES.inc(path=self.path)
+        _SCRAPES.inc(path=path)
         try:
-            if self.path == "/metrics":
+            if path == "/metrics":
                 update_board_gauges(self.store)
                 # SLO gauges (percentile/burn/threshold) are published
                 # by evaluation ticks; run one at scrape time so the
@@ -516,10 +521,28 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                 _slo.evaluate(collector=self.collector)
                 body = _metrics.REGISTRY.render().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif self.path == "/tracez":
+            elif path == "/tracez":
                 body = json.dumps(TRACER.chrome_trace()).encode()
                 ctype = "application/json"
-            elif self.path == "/clusterz":
+            elif path == "/queryz":
+                # range queries over the durable history plane — served
+                # by standbys too (history lives on the shared dir and
+                # do_GET has no primary check by design), which is what
+                # makes the series survive a board failover
+                history = getattr(self.collector, "history", None)
+                if history is None:
+                    return self._respond(404, json.dumps(
+                        {"error": "history not configured (start the "
+                         "docserver with --history-dir or --ha-dir)"}
+                    ).encode())
+                try:
+                    doc = self._queryz(history, query)
+                except ValueError as exc:
+                    return self._respond(400, json.dumps(
+                        {"error": str(exc)}).encode())
+                body = json.dumps(doc, default=float).encode()
+                ctype = "application/json"
+            elif path == "/clusterz":
                 # evaluate HERE too: `cli diagnose` may be the first
                 # scrape a board ever serves, and _slo_findings reads
                 # the derived percentile/burn/threshold gauges this
@@ -531,7 +554,7 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                 body = json.dumps(self.collector.cluster_doc(),
                                   default=float).encode()
                 ctype = "application/json"
-            elif self.path == "/tasks":
+            elif path == "/tasks":
                 body = json.dumps(
                     {"tasks": self.scheduler.list_tasks(),
                      "sched": self.scheduler.snapshot()},
@@ -551,6 +574,58 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             return self._respond(500, json.dumps(
                 {"error": f"{type(exc).__name__}: {exc}"}).encode())
         self._respond(200, body, ctype=ctype)
+
+    @staticmethod
+    def _queryz(history: Any, query: str) -> Dict[str, Any]:
+        """Parse one /queryz query string and run it.
+
+        ``op=query`` (default): ``metric=FAMILY`` plus repeated
+        ``match=label=value`` matchers, ``start``/``end`` (wall
+        seconds; <= 0 means relative to now), ``step`` and
+        ``fn=raw|rate|increase|delta`` (``by_proc=1`` splits counters
+        per pushing proc).  ``op=top``: top-K counter series by rate
+        over ``window``.  ``op=trends``: the persisted trend summary
+        diagnose consumes.  Raises ValueError on bad parameters (the
+        caller answers 400)."""
+        params = urllib.parse.parse_qs(query, keep_blank_values=True)
+
+        def one(name: str, default: Optional[str] = None,
+                ) -> Optional[str]:
+            vals = params.get(name)
+            return vals[-1] if vals else default
+
+        op = one("op", "query")
+        if op == "top":
+            window = float(one("window", "300") or 300)
+            return {"op": "top", "window_s": window,
+                    "series": history.top_series(
+                        k=int(one("k", "10") or 10), window_s=window)}
+        if op == "trends":
+            return {"op": "trends",
+                    "trends": history.trends(
+                        window_s=float(one("window", "300") or 300))}
+        if op != "query":
+            raise ValueError(f"unknown queryz op {op!r}")
+        metric = one("metric")
+        if not metric:
+            raise ValueError("queryz needs metric=FAMILY")
+        matchers: Dict[str, str] = {}
+        for m in params.get("match", []):
+            k, sep, v = m.partition("=")
+            if not sep or not k:
+                raise ValueError(f"bad matcher {m!r} (want label=value)")
+            matchers[k] = v
+        start = one("start")
+        end = one("end")
+        step = one("step")
+        return history.query(
+            metric, matchers=matchers or None,
+            start=float(start) if start is not None else None,
+            end=float(end) if end is not None else None,
+            step=float(step) if step is not None else None,
+            fn=one("fn", "raw") or "raw",
+            by_proc=(one("by_proc", "0") or "0").lower()
+            in ("1", "true", "yes"))
 
     def _execute(self, op: str, req: Dict[str, Any]) -> Any:
         store = self.store
@@ -602,7 +677,11 @@ class DocServer:
                  scheduler_config=None,
                  ha_dir: Optional[str] = None,
                  ha_lease: Optional[float] = None,
-                 ha_fsync: bool = False) -> None:
+                 ha_fsync: bool = False,
+                 history_dir: Optional[str] = None,
+                 history_keep: Optional[int] = None,
+                 history_segment_bytes: Optional[int] = None,
+                 history_max_age_s: Optional[float] = None) -> None:
         # late import: sched builds on coord (no cycle at module load)
         from ..sched.scheduler import Scheduler, SchedulerConfig
 
@@ -623,6 +702,37 @@ class DocServer:
             bound_store: DocStore = self.ha.store
         else:
             bound_store = store if store is not None else MemoryDocStore()
+        # durable telemetry history: defaults onto the HA dir so the
+        # standby tails the same segments and keeps serving /queryz
+        # after failover; an explicit --history-dir works standalone
+        if history_dir is None and ha_dir is not None:
+            history_dir = os.path.join(ha_dir, "history")
+        self.history = None
+        if history_dir is not None:
+            from ..obs.history import MetricHistory
+
+            kwargs: Dict[str, Any] = {"fsync": ha_fsync}
+            if history_keep is not None:
+                kwargs["keep_segments"] = history_keep
+            if history_segment_bytes is not None:
+                kwargs["max_segment_bytes"] = history_segment_bytes
+            if history_max_age_s is not None:
+                kwargs["max_segment_age_s"] = history_max_age_s
+            self.history = MetricHistory(history_dir, **kwargs)
+            # a corrupt segment REFUSES to load (HistoryCorruptError
+            # propagates) — better no history plane than a wrong one
+            self.history.load()
+            # restart-proof burn windows: rebuild the SLO plane's
+            # in-memory deques from persisted bucket deltas so a
+            # burn-rate alert survives the process that raised it
+            from ..obs import slo as _slo
+
+            _slo.PLANE.seed_from_history(self.history)
+            # control-ledger outcomes read their before/after evidence
+            # from history windows instead of racy in-memory snapshots
+            from ..obs import control as _control
+
+            _control.LEDGER.bind_history(self.history)
         handler = type("BoundRpcHandler", (_RpcHandler,), {
             "store": bound_store,
             "done": collections.OrderedDict(),
@@ -631,7 +741,8 @@ class DocServer:
             "dedupe_lock": threading.Lock(),
             "tasks_lock": threading.Lock(),
             "auth_token": default_auth_token(auth_token),
-            "collector": Collector(local_role="server"),
+            "collector": Collector(local_role="server",
+                                   history=self.history),
             "ha": self.ha,
             # every docserver hosts the multi-tenant scheduler surface;
             # admission (tick) stays lease-fenced, so a board whose
@@ -686,6 +797,11 @@ class DocServer:
             # clean handoff: releases the board lease so a standby's
             # next poll promotes immediately, no expiry wait
             self.ha.stop()
+        if self.history is not None:
+            from ..obs import control as _control
+
+            _control.LEDGER.unbind_history(self.history)
+            self.history.close()
 
 
 class HttpDocStore(DocStore):
@@ -867,6 +983,28 @@ class HttpDocStore(DocStore):
             raise PermissionError("clusterz: auth rejected")
         if status != 200:
             raise IOError(f"clusterz: HTTP {status}")
+        return json.loads(raw)
+
+    def queryz(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Run one /queryz range query against the durable history
+        plane (the ``history``/``top`` CLI feed).  *params* maps query
+        parameter names to a value or a list of values (repeated
+        ``match`` matchers)."""
+        pairs: List[Tuple[str, str]] = []
+        for k, v in params.items():
+            for item in (v if isinstance(v, (list, tuple)) else (v,)):
+                pairs.append((str(k), str(item)))
+        qs = urllib.parse.urlencode(pairs)
+        status, raw = self._client.request("GET", f"/queryz?{qs}")
+        if status == 401:
+            raise PermissionError("queryz: auth rejected")
+        if status != 200:
+            try:
+                detail = json.loads(raw).get("error")
+            except ValueError:
+                detail = None
+            raise IOError(f"queryz: HTTP {status}"
+                          + (f" ({detail})" if detail else ""))
         return json.loads(raw)
 
     def close(self) -> None:

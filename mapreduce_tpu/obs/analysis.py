@@ -921,6 +921,53 @@ def _slo_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+#: a per-wave compute cost (or a retry-family rate) whose new trend
+#: window is this many times its old window is called a regression
+TREND_DRIFT_RATIO = 1.5
+
+
+def _history_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Trend regressions computed over the durable history plane's
+    persisted windows (``mrtpuCluster["history"]``, embedded by the
+    collector when a MetricHistory is attached) — the findings survive
+    restarts and work offline on a saved cluster trace because the
+    math already ran against segments, not process memory."""
+    cluster = doc.get("mrtpuCluster") or {}
+    hist = cluster.get("history")
+    if not isinstance(hist, dict) or not hist:
+        return {}
+    if hist.get("error"):
+        return {"error": str(hist["error"])}
+    findings: List[Dict[str, Any]] = []
+    spw = hist.get("compute_s_per_wave") or {}
+    ratio = spw.get("ratio")
+    if ratio is not None and ratio >= TREND_DRIFT_RATIO:
+        findings.append({"kind": "compute_drift",
+                         "old_s_per_wave": spw.get("old"),
+                         "new_s_per_wave": spw.get("new"),
+                         "ratio": ratio})
+    for r in hist.get("rates") or []:
+        # ratio None = the family was silent in the old window and
+        # fired in the new one — trending up from zero, the loudest
+        # kind (this is what a failover's retry/rotation burst is)
+        if r.get("rate_new", 0.0) > 0.0 and (
+                r.get("ratio") is None
+                or r["ratio"] >= TREND_DRIFT_RATIO):
+            findings.append(dict(r, kind="rate_trend"))
+    for b in hist.get("burn") or []:
+        if b.get("burn", 0.0) > 1.0:
+            findings.append(dict(b, kind="persisted_burn"))
+    for proc, j in sorted((hist.get("offset_jumps") or {}).items()):
+        findings.append(dict(j, kind="offset_jump", proc=proc))
+    return {
+        "window_s": hist.get("window_s"),
+        "span_s": hist.get("span_s"),
+        "entries": hist.get("entries"),
+        "procs": hist.get("procs"),
+        "findings": findings,
+    }
+
+
 # -- the report --------------------------------------------------------------
 
 
@@ -950,6 +997,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "slo": _slo_findings(doc),
         "durability": _durability_findings(doc),
         "fleet": _fleet_findings(doc),
+        "trends": _history_findings(doc),
         "control": control,
         "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
@@ -1152,6 +1200,45 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
             "it with `cli warmup --replay` so restarts and capacity "
             "retries hit the persistent cache".format(
                 h["program"], h["total_s"]))
+    trends = report["trends"]
+    for f in trends.get("findings") or []:
+        kind = f.get("kind")
+        if kind == "compute_drift":
+            notes.append(
+                "trend: compute seconds per wave drifted {:.4g}s -> "
+                "{:.4g}s ({:.1f}x) across persisted {:.0f}s "
+                "windows".format(
+                    f.get("old_s_per_wave") or 0.0,
+                    f.get("new_s_per_wave") or 0.0,
+                    f.get("ratio") or 0.0,
+                    trends.get("window_s") or 0.0))
+        elif kind == "rate_trend":
+            notes.append(
+                "trend: {} rate {} -> {:.4g}/s over persisted {:.0f}s "
+                "windows{}".format(
+                    f.get("name"),
+                    ("silent" if not f.get("rate_old")
+                     else "{:.4g}/s".format(f["rate_old"])),
+                    f.get("rate_new") or 0.0,
+                    trends.get("window_s") or 0.0,
+                    (" — appeared from zero" if f.get("ratio") is None
+                     else " ({:.1f}x)".format(f["ratio"]))))
+        elif kind == "persisted_burn":
+            notes.append(
+                "trend: tenant {} {} burning {:.1f}x its error budget "
+                "over the PERSISTED window ({} observations) — this "
+                "alert survives a docserver restart".format(
+                    f.get("tenant"), f.get("objective"),
+                    f.get("burn") or 0.0, f.get("window_n")))
+        elif kind == "offset_jump":
+            notes.append(
+                "trend: proc {} clock offset jumped {:+.3f}s between "
+                "trend windows — its pusher restarted or its clock "
+                "moved; compare history stamps across the jump with "
+                "care".format(f.get("proc"), f.get("jump_s") or 0.0))
+    if trends.get("error"):
+        notes.append("trend analysis unavailable: history plane "
+                     "error ({})".format(trends["error"]))
     if not workers:
         notes.append("no worker job latencies found (no job spans and "
                      "no job-seconds metrics in the document)")
@@ -1296,6 +1383,46 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
                                or {}).items()):
             lines.append(f"  recovered host {host}: streams re-homed "
                          f"({n} sweep hit(s))")
+
+    trends = report.get("trends") or {}
+    if trends and not trends.get("error"):
+        tf = trends.get("findings") or []
+        header = ("history trends ({:.0f}s windows over {} persisted "
+                  "entries, {:.0f}s span):".format(
+                      trends.get("window_s") or 0.0,
+                      trends.get("entries"),
+                      trends.get("span_s") or 0.0))
+        if tf:
+            lines.append(header.upper())
+            for f in tf:
+                kind = f.get("kind")
+                if kind == "compute_drift":
+                    lines.append(
+                        "  compute s/wave {:.4g} -> {:.4g} "
+                        "({:.1f}x)".format(f.get("old_s_per_wave")
+                                           or 0.0,
+                                           f.get("new_s_per_wave")
+                                           or 0.0,
+                                           f.get("ratio") or 0.0))
+                elif kind == "rate_trend":
+                    lines.append(
+                        "  {} {:.4g}/s -> {:.4g}/s{}".format(
+                            f.get("name"), f.get("rate_old") or 0.0,
+                            f.get("rate_new") or 0.0,
+                            (" (from zero)" if f.get("ratio") is None
+                             else "")))
+                elif kind == "persisted_burn":
+                    lines.append(
+                        "  tenant {} {}: {:.1f}x budget over the "
+                        "persisted window".format(
+                            f.get("tenant"), f.get("objective"),
+                            f.get("burn") or 0.0))
+                elif kind == "offset_jump":
+                    lines.append(
+                        "  proc {} offset jumped {:+.3f}s".format(
+                            f.get("proc"), f.get("jump_s") or 0.0))
+        else:
+            lines.append(header + " no regressions")
 
     ctrl = report.get("control") or {}
     if ctrl.get("decisions") or ctrl.get("counts"):

@@ -60,6 +60,7 @@ import time
 import uuid
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from .history import HistoryCorruptError, MetricHistory, note_error
 from .metrics import REGISTRY, Registry, counter, gauge, parse_prometheus
 from .trace import TRACER, Tracer
 
@@ -103,6 +104,12 @@ _COLLECTOR_LOST = counter(
 _COLLECTOR_PROCS = gauge(
     "mrtpu_collector_procs",
     "distinct processes that have pushed telemetry to this collector")
+_CLOCK_OFFSET = gauge(
+    "mrtpu_clock_offset_seconds",
+    "per-process monotonic clock offset estimated by the collector "
+    "(Cristian minimum of recv-send over pushes; labels: proc) — "
+    "exported so history timestamps are auditable and diagnose can "
+    "flag a proc whose offset jumps")
 
 #: spans kept per pushing process (bounded like the local span ring)
 MAX_SPANS_PER_PROC = 50_000
@@ -265,9 +272,13 @@ class Collector:
     """Server half of the telemetry plane (one per docserver)."""
 
     def __init__(self, max_spans_per_proc: int = MAX_SPANS_PER_PROC,
-                 local_role: str = "server") -> None:
+                 local_role: str = "server",
+                 history: Optional[MetricHistory] = None) -> None:
         self.max_spans_per_proc = max(1, int(max_spans_per_proc))
         self.local_role = local_role
+        #: durable telemetry history (obs/history): every accepted push
+        #: with a parseable metrics snapshot appends its deltas there
+        self.history = history
         self._lock = threading.Lock()
         self._procs: Dict[str, Dict[str, Any]] = {}
 
@@ -358,16 +369,38 @@ class Collector:
             while len(buf) > self.max_spans_per_proc:
                 buf.popleft()
                 evicted += 1
+            new_parsed = None
             mtext = payload.get("metrics")
             if mtext:
                 try:
-                    st["metrics"] = parse_prometheus(str(mtext))
+                    new_parsed = parse_prometheus(str(mtext))
+                    st["metrics"] = new_parsed
                 except ValueError:
                     logger.warning(
                         "telemetry push from %s carried an unparseable "
                         "metrics snapshot; keeping the previous one", proc)
             n_procs = len(self._procs)
             missed = st["missed"]
+            offset_now = st["offset"]
+        if offset_now is not None:
+            # the Cristian estimate, exported: history timestamps are
+            # auditable against it and diagnose flags a proc whose
+            # offset jumps between trend windows
+            _CLOCK_OFFSET.set(round(offset_now, 6), proc=proc)
+        if self.history is not None and new_parsed is not None:
+            # history append failures degrade, never refuse telemetry —
+            # but they are counted, and corruption is logged loudly
+            try:
+                self.history.append_snapshot(
+                    proc, new_parsed, role=role, offset_s=offset_now)
+            except HistoryCorruptError as exc:
+                note_error("corrupt")
+                logger.error("telemetry history is corrupt; refusing "
+                             "to append until repaired: %s", exc)
+            except OSError as exc:
+                note_error("io")
+                logger.warning("telemetry history append failed: %s",
+                               exc)
         _COLLECTED_PUSHES.inc(role=role)
         _COLLECTED_SPANS.inc(accepted)
         if nbytes:
@@ -545,16 +578,25 @@ class Collector:
         parsed = [st["metrics"] for _, st in tracks[1:]
                   if st.get("metrics")]
         parsed.append(self._parsed_local(registry))
+        cluster: Dict[str, Any] = {
+            "aligned_to": PROC_ID,
+            "procs": procs_out,
+            "tasks": self._rollups(parsed),
+            "metrics": self._diag_metrics(parsed),
+        }
+        if self.history is not None:
+            # trend windows computed from PERSISTED deltas travel with
+            # the cluster doc, so `cli diagnose` gets the same findings
+            # live, offline on a saved trace, and across restarts
+            try:
+                cluster["history"] = self.history.trends()
+            except (OSError, HistoryCorruptError) as exc:
+                cluster["history"] = {"error": str(exc)}
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"clock": "monotonic", "aligned_to": PROC_ID},
-            "mrtpuCluster": {
-                "aligned_to": PROC_ID,
-                "procs": procs_out,
-                "tasks": self._rollups(parsed),
-                "metrics": self._diag_metrics(parsed),
-            },
+            "mrtpuCluster": cluster,
         }
 
 

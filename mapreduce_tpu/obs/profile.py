@@ -383,6 +383,7 @@ def write_bundle(out_dir: str, store: Any = None,
                  trace_doc: Optional[Dict[str, Any]] = None,
                  jax_trace_dir: Optional[str] = None,
                  cluster_doc: Optional[Dict[str, Any]] = None,
+                 history: Any = None,
                  registry: Registry = REGISTRY,
                  tracer: Tracer = TRACER) -> str:
     """Capture a self-contained profile bundle into *out_dir*.
@@ -512,6 +513,17 @@ def write_bundle(out_dir: str, store: Any = None,
                   encoding="utf-8") as f:
             json.dump(diagnose(cluster_doc), f, indent=1, default=float)
         files += ["cluster_trace.json", "diagnosis.json"]
+    # the durable history plane (obs/history): the live segment files,
+    # copied and RE-VALIDATED after landing (the write-then-reload
+    # discipline every artifact here gets) — a bundle then replays the
+    # run's whole metric history, not just its final snapshot.  Only
+    # written when the history actually holds entries: an empty
+    # history/ dir would read as "nothing ever changed", which is a
+    # lie.
+    history_dir_rel = None
+    if history is not None and history.snapshot().get("entries"):
+        history.copy_segments(os.path.join(out_dir, "history"))
+        history_dir_rel = "history"
 
     manifest: Dict[str, Any] = {
         "kind": "mrtpu-profile-bundle",
@@ -522,6 +534,8 @@ def write_bundle(out_dir: str, store: Any = None,
     }
     if jax_trace_dir and os.path.isdir(jax_trace_dir):
         manifest["jax_trace_dir"] = os.path.relpath(jax_trace_dir, out_dir)
+    if history_dir_rel is not None:
+        manifest["history_dir"] = history_dir_rel
     try:
         import jax
         manifest["jax_version"] = jax.__version__
@@ -597,4 +611,13 @@ def load_bundle(path: str) -> Dict[str, Any]:
     if os.path.exists(diag_path):
         with open(diag_path, encoding="utf-8") as f:
             out["diagnosis"] = json.load(f)
+    hist_dir = os.path.join(path, str(manifest.get("history_dir")
+                                      or "history"))
+    if os.path.isdir(hist_dir):
+        # every entry re-validated; a corrupt segment refuses the load
+        # loudly (obs/history.HistoryCorruptError) instead of serving a
+        # silently wrong series
+        from .history import read_history
+
+        out["history"] = read_history(hist_dir)
     return out

@@ -192,6 +192,21 @@ def slo_snapshot_section(collector=None) -> Dict[str, Any]:
     return slo_snapshot(collector=collector)
 
 
+def history_snapshot_section(collector=None) -> Dict[str, Any]:
+    """The ``history`` row of /statusz: segment/byte/series counts of
+    the durable telemetry history plane (obs/history) when the serving
+    process's collector has one attached; empty — and off the page —
+    otherwise."""
+    history = getattr(collector, "history", None)
+    if history is None:
+        return {}
+    try:
+        return history.snapshot()
+    except OSError:
+        # a stat-level failure must not take /statusz down with it
+        return {"error": "history directory unreadable"}
+
+
 def cluster_status(store, now: Optional[float] = None,
                    collector=None, scheduler=None) -> Dict[str, Any]:
     """The /statusz document: one entry per task database on the board,
@@ -243,6 +258,9 @@ def cluster_status(store, now: Optional[float] = None,
         out["fleet"] = fleet
     if collector is not None:
         out["telemetry"] = collector.summary()
+        hist = history_snapshot_section(collector)
+        if hist:
+            out["history"] = hist
     for db, colls in sorted(_dbnames(store).items()):
         task_doc = None
         if "task" in colls:

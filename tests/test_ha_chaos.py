@@ -211,3 +211,90 @@ def test_sigkill_primary_mid_stream(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=10)
+
+def test_history_survives_board_failover(tmp_path):
+    """The durable history plane across a SIGKILL failover: pushes land
+    on the primary's history segments (under the shared HA dir), the
+    promoted standby serves /queryz over the SAME segments, and a probe
+    counter's total increase matches this process's registry exactly —
+    no gap from the failover, no double count from re-sent batches.
+    The promoted server's trend summary must also carry at least one
+    regression finding (the failover burst: this pusher's
+    client-failover/retry counters fire from zero)."""
+    from mapreduce_tpu.coord.docserver import HttpDocStore
+    from mapreduce_tpu.obs import analysis
+    from mapreduce_tpu.obs.collector import TelemetryPusher
+    from mapreduce_tpu.obs.metrics import REGISTRY, counter
+
+    ha_dir = str(tmp_path / "ha")
+    p1, p2 = _free_port(), _free_port()
+    procs = [_spawn_docserver(p1, ha_dir), _spawn_docserver(p2, ha_dir)]
+    probe = counter("mrtpu_hachaos_probe_total",
+                    "failover-spanning history probe")
+    pusher = TelemetryPusher(f"127.0.0.1:{p1},127.0.0.1:{p2}",
+                             role="hachaos", interval=60.0)
+    try:
+        for port in (p1, p2):
+            _wait(lambda port=port: _healthz(port) is not None, 30,
+                  f"docserver on {port} never served /healthz")
+        roles = _wait(
+            lambda: ({p: (_healthz(p) or {}).get("primary")
+                      for p in (p1, p2)}
+                     if any((_healthz(p) or {}).get("primary")
+                            for p in (p1, p2)) else None),
+            30, "no replica ever took the board lease")
+        prim_port = p1 if roles[p1] else p2
+        stby_port = p2 if prim_port == p1 else p1
+        prim = procs[0] if prim_port == p1 else procs[1]
+
+        # pre-kill: a few delivered increments land in the primary's
+        # history segments
+        for _ in range(3):
+            probe.inc()
+            _wait(pusher.flush, 30, "pre-kill telemetry push failed")
+            time.sleep(0.05)
+
+        os.kill(prim.pid, signal.SIGKILL)
+        prim.wait(timeout=10)
+        # increments DURING the outage: flushes may fail, the backlog
+        # holds them — the cumulative value rides the next success
+        for _ in range(2):
+            probe.inc()
+            pusher.flush()
+            time.sleep(0.05)
+        _wait(lambda: (_healthz(stby_port) or {}).get("primary"), 30,
+              "standby never took over after SIGKILL")
+        probe.inc()
+        _wait(pusher.flush, 30,
+              "no telemetry push succeeded after promotion")
+
+        want = REGISTRY.sum("mrtpu_hachaos_probe_total")
+        assert want == 6.0
+        client = HttpDocStore(f"127.0.0.1:{stby_port}")
+        try:
+            res = client.queryz({"metric": "mrtpu_hachaos_probe_total",
+                                 "fn": "increase", "start": -3600})
+            got = sum(v for s in res["series"]
+                      for _t, v in s["points"])
+            # bit-exact across the failover: no gap (the standby tails
+            # the dead primary's segments), no double count (delta
+            # encoding + seq idempotency eat the re-sent batches)
+            assert got == want, (got, want)
+            row = client.statusz().get("history") or {}
+            assert row.get("entries", 0) >= 2, row
+            doc = client.clusterz()
+        finally:
+            client.close()
+
+        # trend-aware diagnosis over PERSISTED windows on the promoted
+        # server: the failover burst (client failovers / retries from
+        # zero) must surface as at least one regression finding
+        report = analysis.diagnose(doc)
+        findings = (report.get("trends") or {}).get("findings") or []
+        assert findings, report.get("trends")
+    finally:
+        pusher.stop(flush=False)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

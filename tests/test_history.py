@@ -1,0 +1,429 @@
+"""Durable telemetry history plane (obs/history): delta-encoded
+append-only segments, idempotent replay, rotation/retention, /queryz
+range queries, trend analysis, SLO window seeding, control-ledger
+evidence, and the bundle round-trip."""
+
+import json
+import os
+
+import pytest
+
+from mapreduce_tpu.obs.history import (
+    HistoryCorruptError, MetricHistory, read_history, validate_history)
+from mapreduce_tpu.obs.metrics import REGISTRY
+
+
+def _k(name, **labels):
+    return (name, tuple(sorted(labels.items())))
+
+
+def _hist(tmp_path, **kw):
+    return MetricHistory(str(tmp_path / "hist"), **kw)
+
+
+# -- the append/replay substrate ---------------------------------------------
+
+def test_validate_rejects_malformed_entries():
+    good = {"v": 1, "proc": "p", "seq": 1, "t": 10.0,
+            "s": [["mrtpu_x_total", {"a": "b"}, 2.0, 2.0, "c"]]}
+    validate_history(good)
+    for mutate in (
+            lambda e: e.pop("proc"),
+            lambda e: e.__setitem__("seq", 0),
+            lambda e: e.__setitem__("t", "soon"),
+            lambda e: e.__setitem__("s", "rows"),
+            lambda e: e["s"][0].__setitem__(4, "z"),
+            lambda e: e["s"][0].__setitem__(1, ["a", "b"])):
+        bad = json.loads(json.dumps(good))
+        mutate(bad)
+        with pytest.raises(HistoryCorruptError):
+            validate_history(bad)
+
+
+def test_append_is_delta_encoded_and_resend_idempotent(tmp_path):
+    h = _hist(tmp_path)
+    snap = {_k("mrtpu_wc_total", task="wc"): 5.0}
+    assert h.append_snapshot("p0", snap, t=1000.0) is True
+    # a re-sent identical batch writes NOTHING — no double count
+    assert h.append_snapshot("p0", snap, t=1001.0) is False
+    assert h.append_snapshot(
+        "p0", {_k("mrtpu_wc_total", task="wc"): 9.0}, t=1002.0) is True
+    assert h.window_increase("mrtpu_wc_total", 999.0, 1003.0) == 9.0
+    h.close()
+
+
+def test_counter_reset_is_detected_not_negative(tmp_path):
+    h = _hist(tmp_path)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 9.0}, t=1000.0)
+    # the pushing process restarted: cumulative fell to 2 — the delta
+    # must be the new cumulative (2), never 2 - 9 = -7
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 2.0}, t=1010.0)
+    assert h.window_increase("mrtpu_wc_total", 999.0, 1011.0) == 11.0
+    assert h.window_increase("mrtpu_wc_total", 1005.0, 1011.0) == 2.0
+    h.close()
+
+
+def test_replay_reproduces_state_and_never_double_counts(tmp_path):
+    h = _hist(tmp_path)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 5.0}, t=1000.0)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 9.0}, t=1010.0)
+    h.close()
+    h2 = _hist(tmp_path)
+    assert h2.load() == 2
+    # loading again applies nothing: every entry's seq is known
+    assert h2.load() == 0
+    assert h2.window_increase("mrtpu_wc_total", 0.0, 2000.0) == 9.0
+    h2.close()
+
+
+def test_two_writers_one_dir_converge_without_double_count(tmp_path):
+    a = _hist(tmp_path)
+    b = _hist(tmp_path)
+    a.append_snapshot("pa", {_k("mrtpu_wc_total"): 3.0}, t=1000.0)
+    b.append_snapshot("pb", {_k("mrtpu_wc_total"): 4.0}, t=1001.0)
+    a.append_snapshot("pa", {_k("mrtpu_wc_total"): 5.0}, t=1002.0)
+    for h in (a, b):
+        assert h.window_increase("mrtpu_wc_total", 0.0, 2000.0) == 9.0
+    a.close()
+    b.close()
+
+
+def test_size_rotation_and_keep_n_retention(tmp_path):
+    r0 = REGISTRY.sum("mrtpu_history_retired_segments_total")
+    # max_segment_bytes floors at 4096; a fat label makes every entry
+    # exceed it so each append rotates
+    h = _hist(tmp_path, max_segment_bytes=1, keep_segments=3)
+    pad = "x" * 5000
+    for i in range(1, 7):
+        h.append_snapshot("p0", {_k("mrtpu_wc_total", pad=pad): float(i)},
+                          t=1000.0 + i)
+    assert len(h.segment_paths()) <= 3
+    assert REGISTRY.sum("mrtpu_history_retired_segments_total") > r0
+    assert REGISTRY.sum("mrtpu_history_rotations_total",
+                        reason="size") > 0
+    # retention dropped old DELTAS from disk; the replayed view still
+    # counts only what the surviving segments carry (no invention)
+    h2 = _hist(tmp_path)
+    h2.load()
+    assert 0 < h2.window_increase(
+        "mrtpu_wc_total", 0.0, 2000.0) <= 6.0
+    h.close()
+    h2.close()
+
+
+def test_age_rotation(tmp_path):
+    h = _hist(tmp_path, max_segment_age_s=5.0)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 1.0}, t=1000.0)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 2.0}, t=1010.0)
+    assert len(h.segment_paths()) == 2
+    assert REGISTRY.sum("mrtpu_history_rotations_total",
+                        reason="age") > 0
+    h.close()
+
+
+def test_corrupt_segment_refuses_loudly_torn_tail_tolerated(tmp_path):
+    h = _hist(tmp_path)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 5.0}, t=1000.0)
+    seg = h.segment_paths()[0]
+    h.close()
+    # a torn tail (no trailing newline: the writer died mid-write) is
+    # NOT corruption — the complete prefix still loads
+    with open(seg, "a") as f:
+        f.write('{"v":1,"proc":"p0","seq":2')
+    h2 = _hist(tmp_path)
+    assert h2.load() == 1
+    h2.close()
+    # a COMPLETE garbled line is corruption and must refuse
+    with open(seg, "a") as f:
+        f.write('}garbage{\n')
+    with pytest.raises(HistoryCorruptError):
+        _hist(tmp_path).load()
+    with pytest.raises(HistoryCorruptError):
+        read_history(str(tmp_path / "hist"))
+
+
+# -- the query surface -------------------------------------------------------
+
+def _seeded(tmp_path):
+    h = _hist(tmp_path)
+    for i, t in enumerate((1000.0, 1030.0, 1060.0, 1090.0)):
+        h.append_snapshot(
+            "p0", {_k("mrtpu_wc_total", task="wc"): 10.0 * (i + 1),
+                   _k("mrtpu_depth", task="wc"): 5.0 + i}, t=t)
+    h.append_snapshot(
+        "p1", {_k("mrtpu_wc_total", task="wc"): 7.0}, t=1060.0)
+    return h
+
+
+def test_query_raw_is_per_proc_cumulative(tmp_path):
+    h = _seeded(tmp_path)
+    res = h.query("mrtpu_wc_total", fn="raw", start=1.0, now=1100.0)
+    procs = {s["labels"]["proc"]: s["points"] for s in res["series"]}
+    assert [v for _t, v in procs["p0"]] == [10.0, 20.0, 30.0, 40.0]
+    assert [v for _t, v in procs["p1"]] == [7.0]
+    h.close()
+
+
+def test_query_increase_sums_procs_and_steps_align(tmp_path):
+    h = _seeded(tmp_path)
+    res = h.query("mrtpu_wc_total", fn="increase", start=1.0,
+                  now=1100.0)
+    (series,) = res["series"]
+    assert sum(v for _t, v in series["points"]) == 47.0
+    stepped = h.query("mrtpu_wc_total", fn="increase", start=960.0,
+                      end=1100.0, step=60.0, now=1100.0)
+    (s,) = stepped["series"]
+    # the grid is floor-aligned to the step, not to the range start
+    assert all(t % 60.0 == 0 for t, _v in s["points"])
+    assert sum(v for _t, v in s["points"]) == 47.0
+    by_proc = h.query("mrtpu_wc_total", fn="increase", start=1.0,
+                      by_proc=True, now=1100.0)
+    got = {s["labels"]["proc"]: sum(v for _t, v in s["points"])
+           for s in by_proc["series"]}
+    assert got == {"p0": 40.0, "p1": 7.0}
+    h.close()
+
+
+def test_query_rate_gauges_matchers_and_errors(tmp_path):
+    h = _seeded(tmp_path)
+    res = h.query("mrtpu_wc_total", fn="rate", start=1000.0,
+                  end=1100.0, now=1100.0)
+    (s,) = res["series"]
+    # 37 increments with start < t <= end over a 100s window
+    assert sum(v for _t, v in s["points"]) == pytest.approx(0.37)
+    g = h.query("mrtpu_depth", fn="delta", start=1.0, now=1100.0)
+    (gs,) = g["series"]
+    assert gs["points"][-1][1] == 3.0  # last - first = 8 - 5
+    none = h.query("mrtpu_wc_total", matchers={"task": "nope"},
+                   start=1.0, now=1100.0)
+    assert none["series"] == []
+    with pytest.raises(ValueError):
+        h.query("mrtpu_wc_total", fn="median")
+    with pytest.raises(ValueError):
+        h.query("mrtpu_wc_total", start=50.0, end=40.0)
+    h.close()
+
+
+def test_top_series_ranks_by_windowed_increase(tmp_path):
+    h = _hist(tmp_path)
+    h.append_snapshot("p0", {_k("mrtpu_a_total"): 100.0,
+                             _k("mrtpu_b_total"): 3.0,
+                             _k("mrtpu_depth"): 9.0}, t=1000.0)
+    rows = h.top_series(k=5, window_s=300.0, now=1100.0)
+    assert [r["name"] for r in rows] == ["mrtpu_a_total",
+                                        "mrtpu_b_total"]
+    assert rows[0]["increase"] == 100.0
+    h.close()
+
+
+# -- trends ------------------------------------------------------------------
+
+def test_trends_flag_rate_regressions_and_from_zero_bursts(tmp_path):
+    h = _hist(tmp_path)
+    # retries at 1/window in the old window, 5/window in the new; lease
+    # losses appear FROM ZERO in the new window (the failover shape)
+    h.append_snapshot("p0", {
+        _k("mrtpu_http_retries_total", endpoint="x"): 1.0}, t=700.0)
+    h.append_snapshot("p0", {
+        _k("mrtpu_http_retries_total", endpoint="x"): 6.0,
+        _k("mrtpu_worker_lease_lost_total", worker="w"): 2.0},
+        t=1150.0)
+    tr = h.trends(window_s=300.0, now=1200.0, objectives=())
+    rates = {r["name"]: r for r in tr["rates"]}
+    retry = rates["mrtpu_http_retries_total"]
+    assert retry["ratio"] == 5.0
+    burst = rates["mrtpu_worker_lease_lost_total"]
+    assert burst["ratio"] is None and burst["rate_new"] > 0
+    h.close()
+
+
+def test_trends_compute_per_wave_and_offset_jumps(tmp_path):
+    h = _hist(tmp_path)
+    h.append_snapshot("p0", {
+        _k("mrtpu_device_seconds_total", stage="compute"): 1.0,
+        _k("mrtpu_device_waves_total", task="wc"): 10.0},
+        t=700.0, offset_s=0.001)
+    h.append_snapshot("p0", {
+        _k("mrtpu_device_seconds_total", stage="compute"): 4.0,
+        _k("mrtpu_device_waves_total", task="wc"): 20.0},
+        t=1100.0, offset_s=0.5)
+    tr = h.trends(window_s=300.0, now=1200.0, objectives=())
+    spw = tr["compute_s_per_wave"]
+    assert spw["ratio"] == pytest.approx(3.0)  # 0.1 -> 0.3 s/wave
+    assert tr["offset_jumps"]["p0"]["jump_s"] == pytest.approx(0.499)
+    h.close()
+
+
+def test_trends_burn_reads_persisted_bucket_windows(tmp_path):
+    from mapreduce_tpu.obs.slo import SLOObjective
+
+    obj = SLOObjective(name="snap", family="mrtpu_slo_op_seconds",
+                       percentile=0.9, threshold_s=0.5)
+    h = _hist(tmp_path)
+    fam = "mrtpu_slo_op_seconds_bucket"
+    # 10 observations in the new window, 6 over the 0.5s threshold:
+    # frac_ok=0.4 -> burn = 0.6 / 0.1 = 6
+    h.append_snapshot("p0", {
+        _k(fam, tenant="t0", le="0.5"): 4.0,
+        _k(fam, tenant="t0", le="+Inf"): 10.0}, t=1150.0)
+    tr = h.trends(window_s=300.0, now=1200.0, objectives=(obj,))
+    (burn,) = tr["burn"]
+    assert burn["tenant"] == "t0" and burn["window_n"] == 10
+    assert burn["burn"] == pytest.approx(6.0)
+    h.close()
+
+
+def test_slo_seed_from_history_restores_empty_windows(tmp_path):
+    from mapreduce_tpu.obs.slo import SLOObjective, SloPlane
+
+    obj = SLOObjective(name="snap", family="mrtpu_slo_op_seconds",
+                       percentile=0.9, threshold_s=0.5,
+                       long_window_s=600)
+    h = _hist(tmp_path)
+    fam = "mrtpu_slo_op_seconds_bucket"
+    h.append_snapshot("p0", {_k(fam, tenant="t0", le="0.5"): 4.0,
+                             _k(fam, tenant="t0", le="+Inf"): 10.0},
+                      t=1100.0)
+    plane = SloPlane()
+    plane.configure([obj])
+    assert plane.seed_from_history(h, now=50.0, wall_now=1200.0) == 1
+    # seeded windows are never overwritten on a second seed
+    assert plane.seed_from_history(h, now=50.0, wall_now=1200.0) == 0
+    win = plane._windows[("snap", "t0")]
+    (mono_t, cums) = win[-1]
+    assert mono_t == pytest.approx(50.0 - 100.0)  # aged onto monotonic
+    assert cums[float("inf")] == 10.0
+    h.close()
+
+
+def test_control_ledger_resolution_reads_history_evidence(tmp_path):
+    from mapreduce_tpu.obs.control import ControlLedger
+    from mapreduce_tpu.coord import docstore
+
+    h = _hist(tmp_path)
+    led = ControlLedger()
+    led.bind_history(h)
+    did = led.record("capacity", "wc", {"seen": 1}, {"halve": True},
+                     outcome="applied")
+    h.append_snapshot("p0", {
+        _k("mrtpu_device_retries_total", task="wc"): 3.0},
+        t=docstore.now())
+    h.append_snapshot("p0", {
+        _k("mrtpu_device_retries_total", task="wc"): 5.0},
+        t=docstore.now())
+    assert led.resolve(did, "improved") is True
+    dec = led.snapshot()["decisions"][-1]
+    ev = dec["outcome_evidence"]["history_window"]
+    assert ev["increase"]["mrtpu_device_retries_total"] == 5.0
+    led.unbind_history(h)
+    h.close()
+
+
+# -- the wire: /queryz, statusz, CLI, bundles --------------------------------
+
+def test_queryz_over_http_and_statusz_row(tmp_path, capsys):
+    from mapreduce_tpu import cli
+    from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+    from mapreduce_tpu.obs.collector import TelemetryPusher
+    from mapreduce_tpu.obs.metrics import counter
+
+    probe = counter("mrtpu_histtest_probe_total", "history test probe")
+    srv = DocServer(history_dir=str(tmp_path / "hist")).start_background()
+    addr = f"{srv.host}:{srv.port}"
+    pusher = TelemetryPusher(addr, role="histtest", interval=60.0)
+    try:
+        assert pusher.flush()
+        probe.inc(3)
+        assert pusher.flush()
+        client = HttpDocStore(addr)
+        try:
+            res = client.queryz({"metric": "mrtpu_histtest_probe_total",
+                                 "fn": "increase", "start": -3600})
+            total = sum(v for s in res["series"]
+                        for _t, v in s["points"])
+            assert total == REGISTRY.sum("mrtpu_histtest_probe_total")
+            top = client.queryz({"op": "top", "k": 3, "window": 3600})
+            assert top["series"]
+            trends = client.queryz({"op": "trends"})
+            assert "rates" in trends["trends"]
+            with pytest.raises(IOError):
+                client.queryz({"metric": "mrtpu_histtest_probe_total",
+                               "fn": "median"})  # 400
+            with pytest.raises(IOError):
+                client.queryz({"op": "top", "window": "soon"})  # 400
+            snap = client.statusz()
+            row = snap["history"]
+            assert row["entries"] >= 1 and row["segments"] >= 1
+            # the status CLI renders the row
+            text = cli.render_status(snap)
+            assert "history:" in text
+        finally:
+            client.close()
+        # CLI surfaces against the live server
+        assert cli.main(["history", f"http://{addr}",
+                         "--metric", "mrtpu_histtest_probe_total"]) == 0
+        assert "mrtpu_histtest_probe_total" in capsys.readouterr().out
+        assert cli.main(["top", f"http://{addr}", "--k", "3"]) == 0
+        assert "/s" in capsys.readouterr().out
+    finally:
+        pusher.stop(flush=False)
+        srv.shutdown()
+
+
+def test_queryz_404_without_history_plane():
+    from mapreduce_tpu.coord.docserver import DocServer, HttpDocStore
+
+    srv = DocServer().start_background()
+    client = HttpDocStore(f"{srv.host}:{srv.port}")
+    try:
+        with pytest.raises(IOError, match="404"):
+            client.queryz({"metric": "mrtpu_wc_total"})
+    finally:
+        client.close()
+        srv.shutdown()
+
+
+def test_bundle_round_trip_carries_history(tmp_path):
+    from mapreduce_tpu.obs import profile as obs_profile
+
+    h = _hist(tmp_path)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 5.0}, t=1000.0)
+    out = str(tmp_path / "bundle")
+    obs_profile.write_bundle(out, history=h)
+    loaded = obs_profile.load_bundle(out)
+    assert loaded["history"]["entries"] == 1
+    assert loaded["history"]["procs"] == {"p0": 1}
+    # corrupting the bundled segment refuses the whole load
+    seg = os.path.join(out, "history",
+                       os.path.basename(h.segment_paths()[0]))
+    with open(seg, "a") as f:
+        f.write("}garbage{\n")
+    with pytest.raises(HistoryCorruptError):
+        obs_profile.load_bundle(out)
+    h.close()
+
+
+def test_diagnose_renders_trend_findings():
+    from mapreduce_tpu.obs import analysis
+
+    doc = {"traceEvents": [],
+           "mrtpuCluster": {"procs": {}, "history": {
+               "window_s": 300.0, "t_end": 1200.0, "entries": 4,
+               "procs": 1, "span_s": 450.0,
+               "rates": [{"name": "mrtpu_http_retries_total",
+                          "rate_old": 0.0, "rate_new": 0.5,
+                          "ratio": None}],
+               "compute_s_per_wave": {"old": 0.1, "new": 0.3,
+                                      "ratio": 3.0},
+               "offset_jumps": {"p0": {"old": 0.0, "new": 0.5,
+                                       "jump_s": 0.5}},
+               "burn": [{"objective": "snap", "tenant": "t0",
+                         "threshold_s": 0.5, "window_n": 10,
+                         "burn": 6.0}]}}}
+    report = analysis.diagnose(doc)
+    kinds = {f["kind"] for f in report["trends"]["findings"]}
+    assert kinds == {"rate_trend", "compute_drift", "offset_jump",
+                     "persisted_burn"}
+    text = analysis.render_diagnosis(report)
+    assert "HISTORY TRENDS" in text
+    assert any("trend:" in n for n in report["notes"])

@@ -171,6 +171,11 @@ _MONOTONIC_ONLY_MODULES = {
     # also pins down
     os.path.join("mapreduce_tpu", "obs", "collector.py"),
     os.path.join("mapreduce_tpu", "obs", "analysis.py"),
+    # the durable history plane stamps samples with the collector's
+    # offset-corrected wall clock (docstore.now) and aligns windows by
+    # sample age — a raw time.time() here would desynchronise restored
+    # burn windows from the live ones
+    os.path.join("mapreduce_tpu", "obs", "history.py"),
     # the compile & HBM observability plane: compile-seconds histograms
     # and capacity-retry forensics events ARE span/duration data — a
     # steppable clock would corrupt the compile ledger's seconds and

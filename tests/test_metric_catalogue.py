@@ -1,0 +1,102 @@
+"""Catalogue drift lint: the README's metric catalogue and the code's
+registered instrument families must name the same set.
+
+Both directions are static (AST over the package source, regex over the
+README table) so the lint covers runtime-only registrations
+(``mrtpu_board_jobs`` is minted inside ``update_board_gauges``) without
+importing jax-heavy modules, and a family added in code without a
+catalogue row — or a row left behind after a rename — fails loudly with
+the exact names that drifted.
+"""
+
+import ast
+import os
+import re
+
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mapreduce_tpu")
+README = os.path.join(os.path.dirname(PKG_ROOT), "README.md")
+
+#: the instrument constructors whose first positional argument is the
+#: family name (obs/metrics module helpers AND Registry methods)
+_CTORS = {"counter", "gauge", "histogram"}
+
+
+def _source_families():
+    """Every string-literal ``mrtpu_*`` family passed to an instrument
+    constructor anywhere in the package."""
+    fams = set()
+    for dirpath, _dirs, files in os.walk(PKG_ROOT):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r") as f:
+                tree = ast.parse(f.read(), filename=path)
+            # module-level NAME = "mrtpu_..." constants (slo.py names
+            # its families once and passes the constant to histogram())
+            consts = {}
+            for node in tree.body:
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            consts[tgt.id] = node.value.value
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                fname = (node.func.id if isinstance(node.func, ast.Name)
+                         else node.func.attr
+                         if isinstance(node.func, ast.Attribute)
+                         else None)
+                if fname not in _CTORS:
+                    continue
+                arg = node.args[0]
+                val = (arg.value if isinstance(arg, ast.Constant)
+                       else consts.get(arg.id)
+                       if isinstance(arg, ast.Name) else None)
+                if isinstance(val, str) and val.startswith("mrtpu_"):
+                    fams.add(val[len("mrtpu_"):])
+    return fams
+
+
+def _catalogue_families():
+    """Every backticked family in the first cell of the README's
+    metric-catalogue table (rows may carry several families per cell,
+    ``a_total / b_total`` or comma-separated gauge lists)."""
+    with open(README, "r") as f:
+        text = f.read()
+    start = text.index("**Metric catalogue**")
+    fams = set()
+    in_table = False
+    for line in text[start:].splitlines():
+        if line.startswith("|"):
+            in_table = True
+            first_cell = line.split("|")[1]
+            if set(first_cell.strip()) <= {"-", " "}:
+                continue  # the |---| separator row
+            for tok in re.findall(r"`([a-z0-9_]+)`", first_cell):
+                if tok != "family":  # the header row
+                    fams.add(tok)
+        elif in_table:
+            break
+    assert fams, "README metric catalogue table not found"
+    return fams
+
+
+def test_every_registered_family_has_a_catalogue_row():
+    missing = _source_families() - _catalogue_families()
+    assert not missing, (
+        "instrument families registered in code but missing from the "
+        f"README metric catalogue: {sorted(missing)} — add a row "
+        "(all families are documented prefixed-less, e.g. "
+        "`worker_jobs_total`)")
+
+
+def test_every_catalogue_row_names_a_registered_family():
+    stale = _catalogue_families() - _source_families()
+    assert not stale, (
+        "README metric catalogue rows that no longer match any "
+        f"instrument in the package source: {sorted(stale)} — delete "
+        "or rename the row")
