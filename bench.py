@@ -1629,11 +1629,92 @@ def check_smoke() -> int:
     shutil.rmtree(hist_dir, ignore_errors=True)
     shutil.rmtree(bad_dir, ignore_errors=True)
 
+    # alerting gate (obs/alerts): a synthetic rule walks
+    # pending->firing on an injected series under EXPLICIT wall
+    # stamps (no sleeps, nothing to flake), the webhook sink records
+    # exactly ONE delivery across two pumps (the per-sink durable
+    # cursor), and the alerts.json bundle doc survives its strict
+    # validator after a JSON round trip.
+    import http.server as _http_server
+    import threading
+
+    from mapreduce_tpu.obs import alerts as _alerts
+
+    hits = []
+
+    class _Hook(_http_server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(
+                int(self.headers.get("Content-Length", 0)))
+            hits.append(json.loads(body))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    hook = _http_server.ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    hook_thread = threading.Thread(target=hook.serve_forever,
+                                   daemon=True)
+    hook_thread.start()
+    alert_dir = tempfile.mkdtemp(prefix="bench-alerts-")
+    gate_hist = MetricHistory(os.path.join(alert_dir, "hist"))
+    t0 = 1_000_000.0
+    gate_hist.append_snapshot(
+        "bench",
+        {("mrtpu_bench_alert_probe_total", (("task", "gate"),)): 9.0},
+        t=t0)
+    nd0 = REGISTRY.sum("mrtpu_alert_notifications_total",
+                       sink="bench-hook", outcome="delivered")
+    plane = _alerts.AlertPlane(flap_damp_s=0.0)
+    try:
+        plane.configure(
+            [_alerts.parse_alert(
+                "gate:increase(mrtpu_bench_alert_probe_total[60])"
+                ":gt:5:5")],
+            log_dir=os.path.join(alert_dir, "log"),
+            sinks=[_alerts.WebhookSink(
+                "bench-hook", f"127.0.0.1:{hook.server_address[1]}")])
+        plane.evaluate(history=gate_hist, now=t0 + 1)
+        counts = plane.snapshot(now=t0 + 1).get("counts") or {}
+        assert counts.get("pending") == 1, (
+            f"alert gate: expected pending after first sweep, "
+            f"got {counts}")
+        plane.evaluate(history=gate_hist, now=t0 + 7)
+        counts = plane.snapshot(now=t0 + 7).get("counts") or {}
+        assert counts.get("firing") == 1, (
+            f"alert gate: expected firing after for-duration, "
+            f"got {counts}")
+        plane.pump()
+        plane.pump()  # idempotent: the durable cursor already advanced
+        delivered = REGISTRY.sum("mrtpu_alert_notifications_total",
+                                 sink="bench-hook",
+                                 outcome="delivered") - nd0
+        assert delivered == 1 and len(hits) == 1, (
+            f"alert gate: wanted exactly one webhook delivery, "
+            f"counter says {delivered}, receiver saw {len(hits)}")
+        assert hits[0]["rule"] == "gate" and hits[0]["to"] == "firing"
+        alerts_doc = json.loads(json.dumps(
+            {"kind": "mrtpu-alerts", "version": 1,
+             "snapshot": plane.snapshot(now=t0 + 7)}, default=float))
+        _alerts.validate_alerts(alerts_doc)
+    finally:
+        plane.reset()
+        gate_hist.close()
+        hook.shutdown()
+        hook.server_close()
+        shutil.rmtree(alert_dir, ignore_errors=True)
+
     print(json.dumps({
         "mode": "check_smoke", "ok": True,
         "history_gate": {"appends": hist_appends,
                          "queryz_increase": hist_got,
                          "corrupt_refused": True},
+        "alert_gate": {"lifecycle": "pending->firing",
+                       "webhook_deliveries": delivered,
+                       "alerts_json_valid": True},
         "history_runs": len(history),
         "gate_flagged_2x": bad_probs,
         "dispatches_per_wave": dispatches / waves_ran,

@@ -740,6 +740,39 @@ def cmd_docserver(argv: List[str]) -> int:
                     metavar="S",
                     help="rotate the active segment past this age "
                          "(default 300s)")
+    al = p.add_argument_group(
+        "alerting (obs/alerts.py: rules evaluated on this board, every "
+        "lifecycle transition appended to a generation-fenced log on "
+        "the HA dir so a promoted standby resumes pending timers and "
+        "never double-fires; read back at /alertz + `cli alerts`)")
+    al.add_argument("--alert", action="append", default=None,
+                    metavar="SPEC",
+                    help="alert rule NAME:EXPR:OP:THRESHOLD[:FOR_S] "
+                         "(repeatable).  EXPR is rate|increase|delta("
+                         "FAMILY{k=v,...}[WINDOW_S]), burn(OBJECTIVE"
+                         "[,short|long]) or anomaly(FAMILY{...}"
+                         "[WINDOW_S]); e.g. --alert lost:increase("
+                         "mrtpu_worker_lease_lost_total[300]):gt:0:60")
+    al.add_argument("--alert-rules", default=None, metavar="FILE",
+                    help="JSON file of rule specs (array of strings, "
+                         "or {\"rules\": [...]})")
+    al.add_argument("--alert-webhook", action="append", default=None,
+                    metavar="[NAME=]HOST:PORT",
+                    help="POST firing/resolved notifications here "
+                         "(repeatable; NAME keys the durable delivery "
+                         "cursor)")
+    al.add_argument("--alert-exec", action="append", default=None,
+                    metavar="[NAME=]CMD",
+                    help="run CMD per notification, JSON on stdin "
+                         "(repeatable)")
+    al.add_argument("--alert-interval", type=float, default=5.0,
+                    metavar="S",
+                    help="evaluation sweep period (default 5s)")
+    al.add_argument("--alert-damp", type=float, default=None,
+                    metavar="S",
+                    help="a firing rule resolves only after its "
+                         "condition stays clear this long (default "
+                         "30s)")
     _add_slo(p)
     _add_auth(p)
     _add_verbosity(p)
@@ -771,10 +804,19 @@ def cmd_docserver(argv: List[str]) -> int:
                     history_dir=args.history_dir,
                     history_keep=args.history_keep,
                     history_segment_bytes=args.history_segment_bytes,
-                    history_max_age_s=args.history_max_age)
+                    history_max_age_s=args.history_max_age,
+                    alert_rules=args.alert,
+                    alert_rules_file=args.alert_rules,
+                    alert_webhooks=args.alert_webhook,
+                    alert_execs=args.alert_exec,
+                    alert_interval=args.alert_interval,
+                    alert_damp=args.alert_damp)
     role = f"; HA role: {srv.ha.role}" if srv.ha is not None else ""
     hist = (f", durable history at /queryz ({srv.history.dir})"
             if srv.history is not None else "")
+    if srv.alerts is not None:
+        hist += ", alerting at /alertz ({} rule(s))".format(
+            len(srv.alerts.rules))
     print(f"job board at http://{srv.host}:{srv.port} "
           f"(CONNSTR: \"http://HOST:{srv.port}\"; Prometheus at "
           f"/metrics, cluster snapshot at /statusz, merged cluster "
@@ -1084,12 +1126,52 @@ def _render_history(hist: dict) -> List[str]:
     oldest, newest = hist.get("oldest_t"), hist.get("newest_t")
     if oldest is not None and newest is not None:
         span = f", {newest - oldest:.0f}s span"
+    gc = ""
+    if hist.get("rotations") or hist.get("gc_segments"):
+        gc = ", {} rotation(s) / {} gc'd".format(
+            hist.get("rotations", 0), hist.get("gc_segments", 0))
     return ["history: {} segment(s), {} B, {} entr(ies), {} series "
-            "from {} proc(s){} (keep {})".format(
+            "from {} proc(s){}{} (keep {})".format(
                 hist.get("segments", 0), hist.get("bytes", 0),
                 hist.get("entries", 0), hist.get("series", 0),
-                hist.get("procs", 0), span,
+                hist.get("procs", 0), span, gc,
                 hist.get("keep_segments", "?"))]
+
+
+def _render_alerts(al: dict) -> List[str]:
+    """The alerts section of /statusz (obs/alerts): rule + instance
+    lifecycle summary; firing instances are always listed."""
+    if not al:
+        return []
+    counts = al.get("counts") or {}
+    summary = ("  ".join(f"{s}={n}" for s, n in sorted(counts.items()))
+               or "all inactive")
+    log = al.get("log") or {}
+    lines = ["alerts: {} rule(s), {} | log seq {} gen {}{}".format(
+        len(al.get("rules") or []), summary,
+        log.get("seq", 0), log.get("generation", 0),
+        (", {} stale skipped".format(log["skipped_stale"])
+         if log.get("skipped_stale") else ""))]
+    for inst in al.get("instances") or []:
+        if inst.get("state") not in ("firing", "pending"):
+            continue
+        lbl = ",".join(f"{k}={v}" for k, v in
+                       sorted((inst.get("labels") or {}).items()))
+        flags = ""
+        if inst.get("suppressed"):
+            flags += " [silenced]"
+        if inst.get("acked"):
+            flags += " [acked]"
+        lines.append("  {} {}{}: {:.0f}s{}{}".format(
+            inst["state"].upper(), inst.get("rule"),
+            f"{{{lbl}}}" if lbl else "", inst.get("age_s") or 0.0,
+            ("" if inst.get("value") is None
+             else " (value {:.4g})".format(float(inst["value"]))),
+            flags))
+    for s in al.get("silences") or []:
+        lines.append("  silence #{} on {}: {:.0f}s left".format(
+            s.get("id"), s.get("rule"), s.get("expires_in_s") or 0.0))
+    return lines
 
 
 def _render_checkpoint(ck: dict) -> List[str]:
@@ -1144,6 +1226,7 @@ def render_status(snap: dict) -> str:
     lines += _render_fleet(snap.get("fleet") or {})
     lines += _render_slo(snap.get("slo") or {})
     lines += _render_control(snap.get("control") or {})
+    lines += _render_alerts(snap.get("alerts") or {})
     lines += _render_telemetry(snap.get("telemetry") or {})
     lines += _render_history(snap.get("history") or {})
     tasks = snap.get("tasks", {})
@@ -1504,6 +1587,12 @@ def cmd_history(argv: List[str]) -> int:
                    help="server-side function (default increase)")
     p.add_argument("--by-proc", action="store_true", dest="by_proc",
                    help="split counter series per pushing process")
+    p.add_argument("--follow", action="store_true",
+                   help="tail mode: re-issue the range query every "
+                        "--interval and print only new steps (watch a "
+                        "series without a dashboard; ctrl-c exits)")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="--follow poll period (default 2s)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="print the raw /queryz response as JSON")
     _add_auth(p)
@@ -1511,6 +1600,9 @@ def cmd_history(argv: List[str]) -> int:
     args = p.parse_args(argv)
     _setup_logging(args.verbose)
 
+    if args.follow and args.interval <= 0:
+        print("--interval must be > 0", file=sys.stderr)
+        return 2
     for m in args.label:
         if "=" not in m:
             print(f"bad --label {m!r} (want K=V)", file=sys.stderr)
@@ -1527,35 +1619,70 @@ def cmd_history(argv: List[str]) -> int:
     if args.by_proc:
         params["by_proc"] = 1
     try:
-        doc = store.queryz(params)
-    except PermissionError as exc:
-        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
-              file=sys.stderr)
-        return 2
-    except OSError as exc:
-        print(f"cannot query {args.connstr}: {exc}", file=sys.stderr)
-        return 1
+        try:
+            doc = store.queryz(params)
+        except PermissionError as exc:
+            print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+                  file=sys.stderr)
+            return 2
+        except OSError as exc:
+            print(f"cannot query {args.connstr}: {exc}",
+                  file=sys.stderr)
+            return 1
+        if args.as_json and not args.follow:
+            print(json.dumps(doc, indent=2, default=float))
+            return 0
+        series = doc.get("series") or []
+        print(f"{doc.get('metric')} [{doc.get('kind')}] "
+              f"fn={doc.get('fn')} "
+              f"window {doc.get('start')}..{doc.get('end')}"
+              + (f" step {doc.get('step')}s" if doc.get("step")
+                 else ""))
+        if not series and not args.follow:
+            print("  (no samples in range — is the history plane "
+                  "enabled on the docserver, and did anything push?)")
+            return 0
+        last_t = _print_history_points(series, float("-inf"))
+        if not args.follow:
+            return 0
+        # tail mode: re-issue the same trailing-window query and print
+        # only steps newer than anything already shown — `tail -f` for
+        # a metric series
+        import time as _time
+
+        while True:
+            try:
+                _time.sleep(args.interval)
+                doc = store.queryz(params)
+            except KeyboardInterrupt:
+                return 0
+            except (OSError, ValueError) as exc:
+                print(f"  [poll failed: {exc}]", file=sys.stderr)
+                continue
+            last_t = _print_history_points(doc.get("series") or [],
+                                           last_t)
+    except KeyboardInterrupt:
+        return 0
     finally:
         store.close()
-    if args.as_json:
-        print(json.dumps(doc, indent=2, default=float))
-        return 0
-    series = doc.get("series") or []
-    print(f"{doc.get('metric')} [{doc.get('kind')}] fn={doc.get('fn')} "
-          f"window {doc.get('start')}..{doc.get('end')}"
-          + (f" step {doc.get('step')}s" if doc.get("step") else ""))
-    if not series:
-        print("  (no samples in range — is the history plane enabled "
-              "on the docserver, and did anything push?)")
-        return 0
+
+
+def _print_history_points(series: list, last_t: float) -> float:
+    """Print every point newer than *last_t*, label-prefixed; return
+    the new high-water timestamp (the --follow tail cursor)."""
+    newest = last_t
     for s in series:
         labels = ",".join(f"{k}={v}"
                           for k, v in sorted(s["labels"].items()))
-        pts = s.get("points") or []
+        pts = [(t, v) for t, v in (s.get("points") or [])
+               if t > last_t]
+        if not pts:
+            continue
         print(f"  {{{labels}}}: {len(pts)} point(s)")
         for t, v in pts:
-            print(f"    {t:.3f}  {v:g}")
-    return 0
+            print(f"    {t:.3f}  {v:g}", flush=True)
+            newest = max(newest, t)
+    return newest
 
 
 def cmd_top(argv: List[str]) -> int:
@@ -1608,6 +1735,78 @@ def cmd_top(argv: List[str]) -> int:
             r.get("rate", 0.0), r.get("increase", 0.0), r.get("name"),
             f"{{{labels}}}" if labels else ""))
     return 0
+
+
+def cmd_alerts(argv: List[str]) -> int:
+    """The alerting plane (/alertz): list rule + instance lifecycle
+    state, silence or ack a rule, or --watch the lifecycle live.
+    Reads work against ANY replica (standbys tail the shared alert
+    log); silence/ack are board mutations and route to the primary."""
+    p = argparse.ArgumentParser(prog="mapreduce_tpu alerts")
+    p.add_argument("connstr",
+                   help="the docserver, http://HOST:PORT (or the HA "
+                        "replica-set form H1:P1,H2:P2)")
+    p.add_argument("--silence", default=None, metavar="RULE",
+                   help="suppress notifications for RULE ('*' = all) "
+                        "for --duration; the alert keeps evaluating "
+                        "and re-fires when the silence expires")
+    p.add_argument("--duration", type=float, default=3600.0,
+                   metavar="S",
+                   help="--silence length (default 3600s)")
+    p.add_argument("--ack", default=None, metavar="RULE",
+                   help="mark RULE's firing instances acknowledged")
+    p.add_argument("--watch", type=float, default=None, metavar="S",
+                   help="re-poll every S seconds until interrupted")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the raw /alertz JSON instead")
+    _add_auth(p)
+    _add_verbosity(p)
+    args = p.parse_args(argv)
+    _setup_logging(args.verbose)
+
+    store = _docserver_client(args.connstr, args.auth, "alerts")
+    if store is None:
+        return 2
+    import time as _time
+
+    try:
+        if args.silence is not None:
+            res = store.alert_op("silence", args.silence,
+                                 duration=args.duration)
+            print("silenced {} until {:.0f} (id {})".format(
+                res.get("rule"), res.get("until", 0.0),
+                res.get("id")))
+        if args.ack is not None:
+            res = store.alert_op("ack", args.ack)
+            print("acked {} ({} firing instance(s))".format(
+                res.get("rule"), res.get("acked_instances", 0)))
+        while True:
+            doc = store.alertz()
+            if args.as_json:
+                out = json.dumps(doc, indent=2, default=float) + "\n"
+            else:
+                lines = _render_alerts(doc.get("snapshot") or {})
+                out = ("\n".join(lines) + "\n" if lines
+                       else "no alert rules configured on this "
+                            "docserver (--alert / --alert-rules)\n")
+            if args.watch is not None and not args.as_json:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            sys.stdout.write(out)
+            sys.stdout.flush()
+            if args.watch is None:
+                return 0
+            _time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+    except PermissionError as exc:
+        print(f"{exc} (pass --auth or set $MAPREDUCE_TPU_AUTH)",
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        print(f"cannot reach {args.connstr}: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        store.close()
 
 
 def _sched_client(connstr: str, auth, what: str):
@@ -2135,7 +2334,8 @@ COMMANDS = {"server": cmd_server, "worker": cmd_worker,
             "diagnose": cmd_diagnose, "train": cmd_train,
             "submit": cmd_submit, "tasks": cmd_tasks,
             "runner": cmd_runner, "drain": cmd_drain,
-            "history": cmd_history, "top": cmd_top}
+            "history": cmd_history, "top": cmd_top,
+            "alerts": cmd_alerts}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

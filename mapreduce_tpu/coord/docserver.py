@@ -102,6 +102,10 @@ def _rid_session_seq(rid: str) -> Tuple[Optional[str], Optional[int]]:
 
 class _RpcHandler(http.server.BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # status line / headers and body leave in separate writes on an
+    # unbuffered socket; with Nagle on, the body write stalls ~40ms per
+    # keep-alive request waiting for the client's delayed ACK
+    disable_nagle_algorithm = True
     store: DocStore            # set by DocServer
     done: "collections.OrderedDict[str, bytes]"   # rid -> recorded response
     inflight: Dict[str, threading.Event]          # rid -> original executing
@@ -146,6 +150,8 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
             return self._do_telemetry()
         if self.path == "/tasks":
             return self._do_tasks()
+        if self.path == "/alertz":
+            return self._do_alertz()
         if self.path != "/rpc":
             return self._respond(404, b"{}")
         length = int(self.headers.get("Content-Length", 0))
@@ -349,6 +355,49 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     #: ever interleaving (set per-server in DocServer.__init__)
     tasks_lock: threading.Lock
 
+    def _do_alertz(self) -> None:
+        """Operator mutations on the alerting plane: ``silence`` and
+        ``ack``.  Both are durable appends to the generation-fenced
+        alert log, so they run primary-only (the do_POST door already
+        answered 421 for a standby) and auth-gated like /rpc."""
+        length = int(self.headers.get("Content-Length", 0))
+        if not check_auth(self.auth_token, self.headers):
+            self.rfile.read(length)
+            _REQUESTS.inc(op="alertz:-", outcome="unauthorized")
+            return self._respond(401, b"{}")
+        from ..obs import alerts as _alerts
+
+        if not _alerts.PLANE.configured():
+            self.rfile.read(length)
+            return self._respond(404, json.dumps(
+                {"ok": False, "type": "ValueError",
+                 "error": "no alert rules configured (start the "
+                 "docserver with --alert or --alert-rules)"}).encode())
+        try:
+            req = json.loads(self.rfile.read(length))
+            op = req["op"]
+            if op not in ("silence", "ack"):
+                raise KeyError(op)
+        except (json.JSONDecodeError, KeyError, UnicodeDecodeError,
+                TypeError):
+            _REQUESTS.inc(op="alertz:-", outcome="bad_request")
+            return self._respond(400, b"{}")
+        try:
+            if op == "silence":
+                result = _alerts.PLANE.silence(
+                    str(req["rule"]),
+                    float(req.get("duration", 3600.0)))
+            else:
+                result = _alerts.PLANE.ack(str(req["rule"]))
+        except (ValueError, KeyError, TypeError, OSError) as exc:
+            _REQUESTS.inc(op=f"alertz:{op}", outcome="error")
+            return self._respond(400, json.dumps(
+                {"ok": False, "type": type(exc).__name__,
+                 "error": str(exc)}).encode())
+        _REQUESTS.inc(op=f"alertz:{op}", outcome="ok")
+        self._respond(200, json.dumps(
+            {"ok": True, "result": result}).encode())
+
     def _do_tasks(self) -> None:
         """The multi-tenant scheduler surface (sched/scheduler.py):
         ``submit`` / ``cancel`` (rid-deduped like every board mutation
@@ -494,8 +543,8 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
         # /queryz carries its parameters in the query string; every
         # other endpoint ignores one (exact-path matching on the split)
         path, _, query = self.path.partition("?")
-        if path not in ("/metrics", "/statusz", "/tracez",
-                        "/clusterz", "/healthz", "/tasks", "/queryz"):
+        if path not in ("/metrics", "/statusz", "/tracez", "/clusterz",
+                        "/healthz", "/tasks", "/queryz", "/alertz"):
             return self._respond(404, b"{}")
         if path == "/healthz":
             _SCRAPES.inc(path=path)
@@ -538,9 +587,29 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
                 try:
                     doc = self._queryz(history, query)
                 except ValueError as exc:
+                    # typed 400 body (the /rpc error-envelope shape):
+                    # bad step/range/op parameters are the CALLER's
+                    # bug, distinguishable from a 500 by machine
                     return self._respond(400, json.dumps(
-                        {"error": str(exc)}).encode())
+                        {"ok": False, "type": "ValueError",
+                         "error": str(exc)}).encode())
                 body = json.dumps(doc, default=float).encode()
+                ctype = "application/json"
+            elif path == "/alertz":
+                # alert lifecycle state — served from standbys too
+                # (the plane tails the shared alert log on refresh),
+                # so `cli alerts` works against whichever replica
+                # answers after a failover
+                from ..obs import alerts as _alerts
+
+                if not _alerts.PLANE.configured():
+                    return self._respond(404, json.dumps(
+                        {"ok": False, "type": "ValueError",
+                         "error": "no alert rules configured (start "
+                         "the docserver with --alert or "
+                         "--alert-rules)"}).encode())
+                body = json.dumps(_alerts.alertz_doc(),
+                                  default=float).encode()
                 ctype = "application/json"
             elif path == "/clusterz":
                 # evaluate HERE too: `cli diagnose` may be the first
@@ -681,7 +750,13 @@ class DocServer:
                  history_dir: Optional[str] = None,
                  history_keep: Optional[int] = None,
                  history_segment_bytes: Optional[int] = None,
-                 history_max_age_s: Optional[float] = None) -> None:
+                 history_max_age_s: Optional[float] = None,
+                 alert_rules: Optional[List[str]] = None,
+                 alert_rules_file: Optional[str] = None,
+                 alert_webhooks: Optional[List[str]] = None,
+                 alert_execs: Optional[List[str]] = None,
+                 alert_interval: float = 5.0,
+                 alert_damp: Optional[float] = None) -> None:
         # late import: sched builds on coord (no cycle at module load)
         from ..sched.scheduler import Scheduler, SchedulerConfig
 
@@ -733,6 +808,41 @@ class DocServer:
             from ..obs import control as _control
 
             _control.LEDGER.bind_history(self.history)
+        # the alerting plane: rules evaluated on this board, every
+        # transition appended to a generation-fenced log on the shared
+        # dir so a promoted standby resumes pending timers and never
+        # re-fires what the dead primary already fired
+        self._alert_stop: Optional[threading.Event] = None
+        self._alert_thread: Optional[threading.Thread] = None
+        self._alert_interval = float(alert_interval)
+        self.alerts = None
+        rule_specs = list(alert_rules or [])
+        if rule_specs or alert_rules_file:
+            from ..obs import alerts as _alerts
+            from ..obs import slo as _slo
+
+            objective_names = [o.name for o in _slo.PLANE.objectives]
+            rules = [_alerts.parse_alert(s, objectives=objective_names)
+                     for s in rule_specs]
+            if alert_rules_file:
+                rules += _alerts.load_rules_file(
+                    alert_rules_file, objectives=objective_names)
+            sinks: List[Any] = [_alerts.parse_webhook_spec(s)
+                                for s in (alert_webhooks or [])]
+            sinks += [_alerts.parse_exec_spec(s)
+                      for s in (alert_execs or [])]
+            if ha_dir is not None:
+                alert_dir: Optional[str] = os.path.join(ha_dir, "alerts")
+            elif history_dir is not None:
+                alert_dir = os.path.join(history_dir, "alerts")
+            else:
+                alert_dir = None  # burn-only rules, non-durable
+            _alerts.PLANE.configure(
+                rules, log_dir=alert_dir, fsync=ha_fsync,
+                gen_fn=(self.ha.generation if self.ha is not None
+                        else None),
+                sinks=sinks, flap_damp_s=alert_damp)
+            self.alerts = _alerts.PLANE
         handler = type("BoundRpcHandler", (_RpcHandler,), {
             "store": bound_store,
             "done": collections.OrderedDict(),
@@ -774,6 +884,33 @@ class DocServer:
             self.ha.bind_handler(handler)
             self.ha.start()
         self._thread: Optional[threading.Thread] = None
+        if self.alerts is not None:
+            self._alert_stop = threading.Event()
+            self._alert_thread = threading.Thread(
+                target=self._alert_loop, daemon=True,
+                name="alert-evaluator")
+            self._alert_thread.start()
+
+    def _alert_loop(self) -> None:
+        """Evaluate + pump on the primary; standbys only tail the
+        shared alert log so their /alertz stays live.  A sweep failure
+        is loud and non-fatal — the next tick retries."""
+        import logging
+
+        from ..obs import alerts as _alerts
+
+        while not self._alert_stop.wait(self._alert_interval):
+            try:
+                if self.ha is None or self.ha.is_primary():
+                    _alerts.PLANE.evaluate(history=self.history,
+                                           collector=self.collector)
+                    _alerts.PLANE.pump()
+                else:
+                    _alerts.PLANE.refresh()
+            except Exception as exc:
+                logging.getLogger(__name__).warning(
+                    "alert evaluator sweep failed: %s: %s",
+                    type(exc).__name__, exc)
 
     @property
     def connstr(self) -> str:
@@ -789,6 +926,12 @@ class DocServer:
         self.httpd.serve_forever()
 
     def shutdown(self) -> None:
+        if self._alert_stop is not None:
+            self._alert_stop.set()
+            if self._alert_thread is not None:
+                self._alert_thread.join(timeout=10)
+        if self.alerts is not None:
+            self.alerts.reset()
         self.httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=10)
@@ -1006,6 +1149,39 @@ class HttpDocStore(DocStore):
             raise IOError(f"queryz: HTTP {status}"
                           + (f" ({detail})" if detail else ""))
         return json.loads(raw)
+
+    def alertz(self) -> Dict[str, Any]:
+        """Fetch the alerting plane's lifecycle state (the ``alerts``
+        CLI feed) — answered by standbys too, which is how an operator
+        sees the same lifecycle after a failover."""
+        status, raw = self._client.request("GET", "/alertz")
+        if status == 401:
+            raise PermissionError("alertz: auth rejected")
+        if status != 200:
+            try:
+                detail = json.loads(raw).get("error")
+            except ValueError:
+                detail = None
+            raise IOError(f"alertz: HTTP {status}"
+                          + (f" ({detail})" if detail else ""))
+        return json.loads(raw)
+
+    def alert_op(self, op: str, rule: str,
+                 duration: Optional[float] = None) -> Dict[str, Any]:
+        """``silence`` / ``ack`` against the primary's alert plane."""
+        req: Dict[str, Any] = {"op": op, "rule": rule}
+        if duration is not None:
+            req["duration"] = duration
+        status, raw = self._client.request(
+            "POST", "/alertz", body=json.dumps(req).encode())
+        if status == 401:
+            raise PermissionError("alertz: auth rejected")
+        doc = json.loads(raw) if raw else {}
+        if status != 200 or not doc.get("ok"):
+            raise IOError(f"alertz {op}: HTTP {status}"
+                          + (f" ({doc.get('error')})"
+                             if doc.get("error") else ""))
+        return doc.get("result") or {}
 
     def close(self) -> None:
         self._client.close()

@@ -968,6 +968,54 @@ def _history_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _alert_findings(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """The alerting plane's lifecycle state from the cluster doc
+    (``mrtpuCluster["alerts"]``, embedded by the collector when rules
+    are configured) plus cluster-wide transition/delivery counts from
+    the metric roll-up — works offline on a saved trace like every
+    other findings section."""
+    cluster = doc.get("mrtpuCluster") or {}
+    snap = cluster.get("alerts")
+    if not isinstance(snap, dict) or not snap:
+        return {}
+    out: Dict[str, Any] = {
+        "rules": len(snap.get("rules") or []),
+        "counts": snap.get("counts") or {},
+        "firing": [i for i in snap.get("instances") or []
+                   if i.get("state") == "firing"],
+        "pending": [i for i in snap.get("instances") or []
+                    if i.get("state") == "pending"],
+        "silences": snap.get("silences") or [],
+    }
+    transitions: Dict[str, float] = {}
+    deliveries: Dict[str, float] = {}
+    for name, labels, v in _metric_rows(doc):
+        if name == "mrtpu_alert_transitions_total":
+            to = labels.get("to") or "?"
+            transitions[to] = transitions.get(to, 0.0) + v
+        elif (name == "mrtpu_alert_notifications_total"
+              and labels.get("outcome") == "delivered"):
+            sink = labels.get("sink") or "?"
+            deliveries[sink] = deliveries.get(sink, 0.0) + v
+    if transitions:
+        out["transitions"] = transitions
+    if deliveries:
+        out["deliveries"] = deliveries
+    return out
+
+
+def _firing_alert(alerts: Dict[str, Any], **match: Any,
+                  ) -> Optional[Dict[str, Any]]:
+    """The firing instance whose labels carry every *match* pair, or
+    None — the alert-plane analogue of :func:`_acted_on`: a finding
+    the plane is already paging on says so instead of re-alarming."""
+    for inst in alerts.get("firing") or []:
+        labels = inst.get("labels") or {}
+        if all(str(labels.get(k)) == str(v) for k, v in match.items()):
+            return inst
+    return None
+
+
 # -- the report --------------------------------------------------------------
 
 
@@ -980,6 +1028,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
     stragglers, workers, latency_source = _find_stragglers(doc)
     comms = _comms_findings(doc)
     control = _control_findings(doc)
+    alerts = _alert_findings(doc)
     report: Dict[str, Any] = {
         "aligned_to": cluster.get("aligned_to"),
         "n_procs": len(cluster.get("procs") or {}) or None,
@@ -999,6 +1048,7 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         "fleet": _fleet_findings(doc),
         "trends": _history_findings(doc),
         "control": control,
+        "alerts": alerts,
         "critical_path": _overlap_and_critical_path(doc, comms),
         "phases": _phase_breakdown(doc),
         "trace_events": len(doc.get("traceEvents") or []),
@@ -1014,7 +1064,36 @@ def diagnose(doc: Dict[str, Any], skew_ratio: float = SKEW_RATIO,
         dec = _acted_on(control, "reclaim", worker=s.get("worker"))
         if dec is not None:
             s["acted"] = _acted_summary(dec)
+    # alert-aware findings (the control-decision pattern, one plane
+    # up): an SLO breach the alerting plane is already paging on is
+    # annotated with its firing rule instead of re-alarming cold
+    for e in (report["slo"].get("objectives") or []):
+        if not e.get("breaching"):
+            continue
+        inst = _firing_alert(alerts, tenant=e.get("tenant"),
+                             objective=e.get("objective"))
+        if inst is not None:
+            e["alerted"] = inst.get("rule")
     notes: List[str] = []
+    for inst in (alerts.get("firing") or [])[-MAX_NOTE_DECISIONS:]:
+        lbl = ",".join(f"{k}={v}" for k, v in
+                       sorted((inst.get("labels") or {}).items()))
+        note = "alert: {} firing".format(
+            inst.get("rule") + (f"{{{lbl}}}" if lbl else ""))
+        if inst.get("age_s") is not None:
+            note += " for {:.0f}s".format(inst["age_s"])
+        if inst.get("value") is not None:
+            note += " (value {:.4g})".format(float(inst["value"]))
+        if inst.get("suppressed"):
+            note += " [silenced]"
+        if inst.get("acked"):
+            note += " [acked]"
+        notes.append(note)
+    for inst in (alerts.get("pending") or [])[-MAX_NOTE_DECISIONS:]:
+        notes.append(
+            "alert: {} pending ({}s into its for-duration)".format(
+                inst.get("rule"),
+                int(inst.get("pending_for_s") or 0)))
     # newest MAX_NOTE_DECISIONS only (the cli statusz cap): an active
     # reclaimer/advisor writes one ledger row per decision, and
     # hundreds of "control:" lines would drown the skew/straggler
@@ -1349,10 +1428,12 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
                 if e.get("burn_short") is not None:
                     burns += " / {:.1f}x short".format(e["burn_short"])
             lines.append(
-                "  tenant {} {} {}: {:.3g}s{}{}{}".format(
+                "  tenant {} {} {}: {:.3g}s{}{}{}{}".format(
                     e["tenant"], e["pct"], e["objective"], e["p_s"],
                     thr, burns,
-                    "  BREACHING" if e["breaching"] else ""))
+                    "  BREACHING" if e["breaching"] else "",
+                    ("  [alerting: {}]".format(e["alerted"])
+                     if e.get("alerted") else "")))
         for t, age in sorted(
                 (slo.get("oldest_queued_age_s") or {}).items()):
             lines.append(
@@ -1440,6 +1521,24 @@ def render_diagnosis(report: Dict[str, Any]) -> str:
             lines.append("  (+{} earlier decisions; --json for the "
                          "full ledger)".format(
                              len(decs) - MAX_NOTE_DECISIONS))
+
+    al = report.get("alerts") or {}
+    if al:
+        counts = al.get("counts") or {}
+        lines.append("alerts ({} rule(s)): ".format(al.get("rules"))
+                     + ("  ".join(f"{s}={n}" for s, n in
+                                  sorted(counts.items()))
+                        or "all inactive"))
+        for inst in al.get("firing") or []:
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted((inst.get("labels") or {}).items()))
+            lines.append("  FIRING {}{}{}".format(
+                inst.get("rule"), f"{{{lbl}}}" if lbl else "",
+                " [silenced]" if inst.get("suppressed") else ""))
+        for to, n in sorted((al.get("transitions") or {}).items()):
+            lines.append(f"  transitions to {to}: {int(n)}")
+        for sink, n in sorted((al.get("deliveries") or {}).items()):
+            lines.append(f"  sink {sink}: {int(n)} delivered")
 
     comp = report.get("compile_hotspots") or []
     if comp:

@@ -204,6 +204,14 @@ DIAG_FAMILIES = frozenset({
     # wherever it ran (the decisions themselves travel as
     # control_decision spans on the merged timeline)
     "mrtpu_control_decisions_total",
+    # the alerting plane (obs/alerts): lifecycle transitions, sink
+    # delivery outcomes and history-store GC pressure roll up so
+    # diagnose can cross-reference firing alerts wherever the board
+    # that evaluated them ran
+    "mrtpu_alert_transitions_total",
+    "mrtpu_alert_notifications_total",
+    "mrtpu_alerts_firing",
+    "mrtpu_history_gc_total",
 })
 
 #: diagnosis gauges that must merge across processes by MAX, not sum:
@@ -233,6 +241,9 @@ _DIAG_GAUGE_MAX = frozenset({
     "mrtpu_slo_threshold_seconds",
     "mrtpu_sched_oldest_queued_age_seconds",
     "mrtpu_session_stream_age_seconds",
+    # firing-alert counts are primary-authoritative; a standby's zero
+    # (or a stale pushed copy) must not dilute the evaluating board's
+    "mrtpu_alerts_firing",
 })
 
 #: and gauges where the WORST view is the smallest value: an overlap
@@ -592,6 +603,14 @@ class Collector:
                 cluster["history"] = self.history.trends()
             except (OSError, HistoryCorruptError) as exc:
                 cluster["history"] = {"error": str(exc)}
+        # the alert plane rides the cluster doc the way the control
+        # ledger's decisions do: diagnose cross-references a firing
+        # alert into its findings live AND offline on a saved trace
+        from . import alerts as _alerts
+
+        alert_snap = _alerts.alerts_snapshot()
+        if alert_snap:
+            cluster["alerts"] = alert_snap
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",

@@ -122,6 +122,10 @@ _ROTATIONS = counter(
 _RETIRED = counter(
     "mrtpu_history_retired_segments_total",
     "segments deleted by keep-N retention")
+_GC = counter(
+    "mrtpu_history_gc_total",
+    "segments garbage-collected by keep-N retention, labelled with the"
+    " rotation reason (size|age) whose sweep reclaimed them")
 _SEGMENTS_G = gauge(
     "mrtpu_history_segments", "live history segment files")
 _BYTES_G = gauge(
@@ -259,6 +263,8 @@ class MetricHistory:
         self._seg_first_t: Dict[str, float] = {}
         self._offset_hist: Dict[str, List[Tuple[float, float]]] = {}
         self._entries = 0
+        self._rotations = 0
+        self._gc_segments = 0
         self._oldest_t: Optional[float] = None
         self._newest_t: Optional[float] = None
 
@@ -307,6 +313,7 @@ class MetricHistory:
         self._writer = MutationLog(
             os.path.join(self.dir, self._writer_name), fsync=self.fsync)
         _ROTATIONS.inc(reason=reason)
+        self._rotations += 1
         # keep-N retention: oldest segments (and their read state) go
         segs = self._segment_files()
         while len(segs) > self.keep_segments:
@@ -318,6 +325,8 @@ class MetricHistory:
             self._offsets.pop(victim, None)
             self._seg_first_t.pop(victim, None)
             _RETIRED.inc()
+            _GC.inc(reason=reason)
+            self._gc_segments += 1
 
     def _disk_stats_locked(self) -> Tuple[int, int]:
         total = 0
@@ -659,7 +668,10 @@ class MetricHistory:
                     "increase": inc,
                     "rate": round(inc / float(window_s), 6),
                 })
-        rows.sort(key=lambda r: (-r["increase"], r["name"]))
+        # labels join the tie-break so equal-increase series render in
+        # one deterministic order across procs and replays
+        rows.sort(key=lambda r: (-r["increase"], r["name"],
+                                 sorted(r["labels"].items())))
         return rows[:max(1, int(k))]
 
     # -- trend analysis ----------------------------------------------------
@@ -857,6 +869,8 @@ class MetricHistory:
                 "segments": n_segs,
                 "bytes": n_bytes,
                 "entries": self._entries,
+                "rotations": self._rotations,
+                "gc_segments": self._gc_segments,
                 "series": len(self._series),
                 "procs": len(self._applied),
                 "oldest_t": (round(self._oldest_t, 3)

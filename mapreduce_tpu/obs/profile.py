@@ -503,6 +503,20 @@ def write_bundle(out_dir: str, store: Any = None,
                   encoding="utf-8") as f:
             json.dump(ctrl_doc, f, indent=1, default=float)
         files.append("control_ledger.json")
+    # the alerting plane (obs/alerts): configured rules, instance
+    # lifecycle states and silences — same only-when-armed contract as
+    # the control ledger, same validate-on-write-AND-reload discipline
+    from .alerts import alerts_snapshot, validate_alerts
+
+    alert_snap = alerts_snapshot()
+    if alert_snap:
+        alert_doc = {"kind": "mrtpu-alerts", "version": 1,
+                     "snapshot": alert_snap}
+        validate_alerts(alert_doc)
+        with open(os.path.join(out_dir, "alerts.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(alert_doc, f, indent=1, default=float)
+        files.append("alerts.json")
     if cluster_doc is not None:
         from .analysis import diagnose
 
@@ -601,6 +615,14 @@ def load_bundle(path: str) -> Dict[str, Any]:
             ctrl_doc = json.load(f)
         validate_control(ctrl_doc)
         out["control_ledger"] = ctrl_doc
+    alerts_path = os.path.join(path, "alerts.json")
+    if os.path.exists(alerts_path):
+        from .alerts import validate_alerts
+
+        with open(alerts_path, encoding="utf-8") as f:
+            alert_doc = json.load(f)
+        validate_alerts(alert_doc)
+        out["alerts"] = alert_doc
     cluster_path = os.path.join(path, "cluster_trace.json")
     if os.path.exists(cluster_path):
         with open(cluster_path, encoding="utf-8") as f:

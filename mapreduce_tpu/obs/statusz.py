@@ -181,6 +181,16 @@ def control_snapshot_section() -> Dict[str, Any]:
     return control_snapshot()
 
 
+def alerts_snapshot_section() -> Dict[str, Any]:
+    """The alerts section of /statusz (obs/alerts): configured rules,
+    live instance lifecycle states and active silences.  Empty when no
+    rules are configured, so a plane that was never armed provably
+    shows nothing."""
+    from .alerts import alerts_snapshot
+
+    return alerts_snapshot()
+
+
 def slo_snapshot_section(collector=None) -> Dict[str, Any]:
     """The SLO section of /statusz (obs/slo): per-tenant objective
     percentiles, error budget and burn rates, evaluated at scrape time
@@ -243,6 +253,9 @@ def cluster_status(store, now: Optional[float] = None,
     ctrl = control_snapshot_section()
     if ctrl:
         out["control"] = ctrl
+    alerts_sec = alerts_snapshot_section()
+    if alerts_sec:
+        out["alerts"] = alerts_sec
     if scheduler is not None:
         sched = scheduler.snapshot()
         if sched:

@@ -1,0 +1,508 @@
+"""Alerting plane (obs/alerts): the rule grammar, the
+inactive→pending→firing→resolved state machine, the durable
+generation-fenced alert log (failover resume, stale-write fencing),
+exactly-once sink delivery via per-sink cursors, silences/acks and
+refire-on-expiry, anomaly scoring, and the /alertz + bundle surfaces."""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from mapreduce_tpu.obs import alerts
+from mapreduce_tpu.obs.alerts import (
+    AlertPlane, ExecSink, WebhookSink, load_rules_file, parse_alert,
+    parse_exec_spec, parse_webhook_spec, validate_alerts)
+from mapreduce_tpu.obs.history import MetricHistory
+from mapreduce_tpu.obs.metrics import REGISTRY
+
+T0 = 1_000_000.0
+FAMILY = "mrtpu_alert_probe_total"
+
+
+def _k(name, **labels):
+    return (name, tuple(sorted(labels.items())))
+
+
+def _hist(tmp_path, **kw):
+    return MetricHistory(str(tmp_path / "hist"), **kw)
+
+
+def _probe_hist(tmp_path, counts, step_s=10.0):
+    """History holding one counter series sampled every *step_s*."""
+    h = _hist(tmp_path)
+    for i, c in enumerate(counts):
+        h.append_snapshot("p0", {_k(FAMILY, task="wc"): float(c)},
+                          t=T0 + step_s * i)
+    return h
+
+
+class _MemSink:
+    """In-memory sink recording every notification; optionally fails
+    the first *fail_first* deliveries (the retry-without-advancing
+    cursor path)."""
+
+    def __init__(self, name="mem", fail_first=0):
+        self.name = name
+        self.docs = []
+        self._fail = fail_first
+
+    def deliver(self, doc):
+        if self._fail > 0:
+            self._fail -= 1
+            raise IOError("injected sink failure")
+        self.docs.append(doc)
+
+
+# -- rule grammar -------------------------------------------------------------
+
+def test_parse_threshold_rule_and_defaults():
+    r = parse_alert("hot:rate(mrtpu_wc_total{task=wc}[60]):>:5:30")
+    assert (r.name, r.kind, r.fn) == ("hot", "threshold", "rate")
+    assert r.family == "mrtpu_wc_total"
+    assert r.matchers == {"task": "wc"}
+    assert (r.window_s, r.op, r.threshold, r.for_s) == (60.0, "gt", 5.0,
+                                                        30.0)
+    # word ops, default window, default for-duration
+    r2 = parse_alert("cold:increase(mrtpu_wc_total):lt:1")
+    assert (r2.op, r2.window_s, r2.for_s) == (
+        "lt", alerts.DEFAULT_WINDOW_S, 0.0)
+    d = r.describe()
+    assert d["fn"] == "rate" and d["matchers"] == {"task": "wc"}
+    a = parse_alert("odd:anomaly(mrtpu_wc_total[20]):ge:6")
+    assert a.kind == "anomaly" and "fn" not in a.describe()
+    b = parse_alert("burny:burn(avail,short):>=:2:10",
+                    objectives=["avail"])
+    assert (b.kind, b.objective, b.burn_window) == ("burn", "avail",
+                                                    "short")
+
+
+def test_parse_rejects_bad_specs():
+    for spec, msg in [
+            ("a:b:c", "want NAME:EXPR:OP:THRESHOLD"),
+            ("no spaces!:rate(x):>:1", "bad alert name"),
+            ("a:rate(mrtpu_x_total):~:1", "bad alert op"),
+            ("a:rate(mrtpu_x_total):>:warm", "bad alert threshold"),
+            ("a:rate(mrtpu_x_total):>:1:soon", "bad alert for-duration"),
+            ("a:rate(mrtpu_x_total):>:1:-5", "for-duration must be >= 0"),
+            ("a:mrtpu_x_total:>:1", "bad alert expr"),
+            ("a:median(mrtpu_x_total):>:1", "bad alert expr function"),
+            ("a:rate(mrtpu_x_total[0]):>:1", "window must be > 0"),
+            ("a:rate(mrtpu_x_total{task}):>:1", "bad alert matcher"),
+            ("a:burn(avail,medium):>:1", "bad alert burn window"),
+            ("a:burn():>:1", "wants an objective name")]:
+        with pytest.raises(ValueError, match=msg):
+            parse_alert(spec)
+    # burn() binds the configured objective set: a typo fails at parse
+    # time, not silently at evaluation time
+    with pytest.raises(ValueError, match="unknown alert objective"):
+        parse_alert("a:burn(availability):>:1", objectives=["avail"])
+
+
+def test_load_rules_file_both_shapes_and_reject(tmp_path):
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(
+        ["a:rate(mrtpu_x_total):>:1", "b:increase(mrtpu_y_total):<:2:9"]))
+    rules = load_rules_file(str(bare))
+    assert [r.name for r in rules] == ["a", "b"]
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps(
+        {"rules": ["c:delta(mrtpu_z_total[30]):>=:0.5"]}))
+    assert [r.name for r in load_rules_file(str(wrapped))] == ["c"]
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"alerts": []}))
+    with pytest.raises(ValueError, match="want a JSON array"):
+        load_rules_file(str(bad))
+
+
+def test_sink_spec_parsing():
+    s = parse_webhook_spec("pager=127.0.0.1:9093")
+    assert s.name == "pager"
+    assert parse_webhook_spec(
+        "127.0.0.1:9093").name == "webhook-127.0.0.1-9093"
+    with pytest.raises(ValueError):
+        parse_webhook_spec("no-port-here")
+    e = parse_exec_spec("log=cat /dev/null")
+    assert e.name == "log" and e.argv[0] == "cat"
+    assert parse_exec_spec("/usr/bin/true").name == "exec-true"
+    with pytest.raises(ValueError):
+        parse_exec_spec("noop=")
+
+
+# -- the state machine --------------------------------------------------------
+
+def test_lifecycle_pending_firing_resolved(tmp_path):
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    t = T0 + 10.0
+    sink = _MemSink()
+    plane = AlertPlane(flap_damp_s=0.0)
+    plane.configure(
+        [parse_alert(f"hot:increase({FAMILY}[60]):>:5:5")],
+        log_dir=str(tmp_path / "alerts"), sinks=[sink])
+    try:
+        plane.evaluate(history=h, now=t)
+        snap = plane.snapshot(now=t)
+        assert snap["counts"] == {"pending": 1}
+        (inst,) = snap["instances"]
+        assert inst["state"] == "pending" and inst["value"] == 9.0
+        assert inst["labels"] == {"task": "wc"}
+        # pending is NOT notifiable — sinks only hear firing/resolved
+        assert plane.pump() == {}
+        # still inside the for-duration: stays pending
+        plane.evaluate(history=h, now=t + 4.0)
+        assert plane.snapshot(now=t + 4.0)["counts"] == {"pending": 1}
+        plane.evaluate(history=h, now=t + 5.0)
+        snap = plane.snapshot(now=t + 5.0)
+        assert snap["counts"] == {"firing": 1}
+        assert REGISTRY.sum("mrtpu_alerts_firing") == 1.0
+        assert plane.pump() == {"mem": 1}
+        assert plane.pump() == {}  # cursor advanced: no re-delivery
+        (doc,) = sink.docs
+        assert (doc["rule"], doc["to"]) == ("hot", "firing")
+        # the window drains: condition clears, instance resolves
+        h.append_snapshot("p0", {_k(FAMILY, task="wc"): 9.0},
+                          t=t + 100.0)
+        plane.evaluate(history=h, now=t + 100.0)
+        snap = plane.snapshot(now=t + 100.0)
+        assert snap["counts"] == {"resolved": 1}
+        assert REGISTRY.sum("mrtpu_alerts_firing") == 0.0
+        assert plane.pump() == {"mem": 1}
+        assert [d["to"] for d in sink.docs] == ["firing", "resolved"]
+    finally:
+        plane.reset()
+        h.close()
+
+
+def test_for_zero_fires_immediately_and_pending_clears(tmp_path):
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    t = T0 + 10.0
+    plane = AlertPlane(flap_damp_s=0.0)
+    plane.configure([parse_alert(f"now:increase({FAMILY}[60]):>:5")])
+    try:
+        plane.evaluate(history=h, now=t)
+        assert plane.snapshot(now=t)["counts"] == {"firing": 1}
+    finally:
+        plane.reset()
+    # a pending instance whose condition clears goes back to inactive
+    # (and the idle instance is dropped — no unbounded growth)
+    plane2 = AlertPlane(flap_damp_s=0.0)
+    plane2.configure([parse_alert(f"slow:increase({FAMILY}[60]):>:5:30")])
+    try:
+        plane2.evaluate(history=h, now=t)
+        assert plane2.snapshot(now=t)["counts"] == {"pending": 1}
+        h.append_snapshot("p0", {_k(FAMILY, task="wc"): 9.0},
+                          t=t + 100.0)
+        plane2.evaluate(history=h, now=t + 100.0)
+        plane2.evaluate(history=h, now=t + 101.0)
+        assert plane2.snapshot(now=t + 101.0)["instances"] == []
+    finally:
+        plane2.reset()
+        h.close()
+
+
+# -- durable log: failover resume + generation fencing ------------------------
+
+def test_failover_resumes_pending_and_fences_stale_writes(tmp_path):
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    t = T0 + 10.0
+    log_dir = str(tmp_path / "alerts")
+    rule = f"hot:increase({FAMILY}[60]):>:5:5"
+    old_sink = _MemSink(name="pager")
+    primary = AlertPlane(flap_damp_s=0.0)
+    primary.configure([parse_alert(rule)], log_dir=log_dir,
+                      gen_fn=lambda: 1, sinks=[old_sink])
+    primary.evaluate(history=h, now=t)
+    assert primary.snapshot(now=t)["counts"] == {"pending": 1}
+
+    # the primary is SIGKILLed mid-window; a standby promotes at gen 2
+    # over the same shared dir and replays the log: the pending timer
+    # resumes from its original start, it does not restart
+    standby = AlertPlane(flap_damp_s=0.0)
+    new_sink = _MemSink(name="pager")
+    standby.configure([parse_alert(rule)], log_dir=log_dir,
+                      gen_fn=lambda: 2, sinks=[new_sink])
+    snap = standby.snapshot(now=t + 1.0)
+    assert snap["counts"] == {"pending": 1}
+    assert snap["log"]["replayed"] >= 1
+    standby.evaluate(history=h, now=t + 5.0)
+    assert standby.snapshot(now=t + 5.0)["counts"] == {"firing": 1}
+    assert standby.snapshot(now=t + 5.0)["log"]["generation"] == 2
+    assert standby.pump() == {"pager": 1}
+
+    # the dead primary's last buffered write lands late: a gen-1
+    # "resolved" that would wrongly clear the page.  The standby's
+    # tail skips it (fence), and nothing new becomes notifiable
+    from mapreduce_tpu.coord.persistent_table import MutationLog
+    late = MutationLog(os.path.join(log_dir, "alert.log"))
+    late.append({"kind": "transition", "rule": "hot",
+                 "labels": {"task": "wc"}, "from": "firing",
+                 "to": "resolved", "t": t + 6.0, "value": 0.0,
+                 "g": 1, "n": 99})
+    late.close()
+    standby.refresh()
+    assert standby.snapshot(now=t + 6.0)["log"]["skipped_stale"] >= 1
+    assert standby.pump() == {}
+    assert [d["to"] for d in new_sink.docs] == ["firing"]
+    # a third plane replaying the whole log from scratch lands in the
+    # same state — the stale entry is skipped on replay too
+    reader = AlertPlane(flap_damp_s=0.0)
+    reader.configure([parse_alert(rule)], log_dir=log_dir,
+                     gen_fn=lambda: 2)
+    rsnap = reader.snapshot(now=t + 6.0)
+    assert rsnap["counts"] == {"firing": 1}
+    assert rsnap["log"]["skipped_stale"] >= 1
+    reader.reset()
+    standby.reset()
+    primary.reset()
+    h.close()
+
+
+def test_pump_retries_without_advancing_cursor(tmp_path):
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    t = T0 + 10.0
+    err0 = REGISTRY.sum("mrtpu_alert_notifications_total",
+                        outcome="error")
+    sink = _MemSink(name="flaky", fail_first=1)
+    plane = AlertPlane(flap_damp_s=0.0)
+    plane.configure([parse_alert(f"hot:increase({FAMILY}[60]):>:5")],
+                    log_dir=str(tmp_path / "alerts"), sinks=[sink])
+    try:
+        plane.evaluate(history=h, now=t)
+        # first pump fails: error counted, cursor NOT advanced
+        assert plane.pump() == {}
+        assert REGISTRY.sum("mrtpu_alert_notifications_total",
+                            sink="flaky", outcome="error") == err0 + 1
+        # second pump re-reads the cursor from disk and retries the
+        # SAME transition — delivered exactly once overall
+        assert plane.pump() == {"flaky": 1}
+        assert plane.pump() == {}
+        assert len(sink.docs) == 1 and sink.docs[0]["seq"] >= 1
+    finally:
+        plane.reset()
+        h.close()
+
+
+# -- silences, acks, refire on expiry -----------------------------------------
+
+def test_silence_suppresses_then_expiry_refires_once(tmp_path):
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    t = T0 + 10.0
+    sink = _MemSink()
+    plane = AlertPlane(flap_damp_s=0.0)
+    plane.configure([parse_alert(f"hot:increase({FAMILY}[60]):>:5")],
+                    log_dir=str(tmp_path / "alerts"), sinks=[sink])
+    try:
+        plane.silence("hot", 30.0, now=t)
+        plane.evaluate(history=h, now=t)
+        snap = plane.snapshot(now=t)
+        assert snap["counts"] == {"firing": 1}
+        assert snap["instances"][0]["suppressed"] is True
+        assert snap["silences"][0]["rule"] == "hot"
+        assert plane.pump() == {}  # silenced: nobody paged
+        # the silence expires against a still-firing instance: that is
+        # a page (refire), delivered exactly once
+        plane.evaluate(history=h, now=t + 31.0)
+        snap = plane.snapshot(now=t + 31.0)
+        assert snap["counts"] == {"firing": 1}
+        assert not snap["instances"][0].get("suppressed")
+        assert snap["silences"] == []
+        assert plane.pump() == {"mem": 1}
+        assert plane.pump() == {}
+        (doc,) = sink.docs
+        assert doc["refire"] is True and doc["to"] == "firing"
+        # ack is cosmetic but durable-surfaced
+        assert plane.ack("hot")["acked_instances"] == 1
+        assert plane.snapshot(now=t + 31.0)["instances"][0]["acked"]
+    finally:
+        plane.reset()
+        h.close()
+
+
+def test_silence_and_ack_validation(tmp_path):
+    plane = AlertPlane()
+    plane.configure([parse_alert("a:rate(mrtpu_x_total):>:1")])
+    try:
+        with pytest.raises(ValueError, match="unknown alert rule"):
+            plane.silence("nope", 10.0, now=T0)
+        with pytest.raises(ValueError, match="duration must be > 0"):
+            plane.silence("a", 0.0, now=T0)
+        with pytest.raises(ValueError, match="unknown alert rule"):
+            plane.ack("nope")
+        # "*" silences every rule
+        plane.silence("*", 10.0, now=T0)
+        assert plane.snapshot(now=T0)["silences"][0]["rule"] == "*"
+    finally:
+        plane.reset()
+
+
+# -- anomaly + burn evaluation ------------------------------------------------
+
+def test_anomaly_rule_scores_spike_against_baseline(tmp_path):
+    # steady +1/window for 9 windows, then a +50 spike in the current
+    # one: MAD-scaled deviation is huge
+    h = _probe_hist(tmp_path, [float(i) for i in range(10)] + [59.0])
+    now = T0 + 100.0
+    plane = AlertPlane(flap_damp_s=0.0)
+    plane.configure([parse_alert(f"spike:anomaly({FAMILY}[10]):gt:10")])
+    try:
+        # too little history: fewer than ANOMALY_MIN_BASELINE covered
+        # windows means no score at all (no false page at startup)
+        plane.evaluate(history=h, now=T0 + 30.0)
+        assert plane.snapshot(now=T0 + 30.0)["instances"] == []
+        plane.evaluate(history=h, now=now)
+        snap = plane.snapshot(now=now)
+        assert snap["counts"] == {"firing": 1}
+        assert snap["instances"][0]["value"] > 10
+    finally:
+        plane.reset()
+        h.close()
+
+
+def test_burn_rule_reads_slo_plane(monkeypatch):
+    from mapreduce_tpu.obs import slo as _slo
+    monkeypatch.setattr(
+        _slo.PLANE, "evaluate",
+        lambda **kw: {"tenants": {"t0": {"avail": {
+            "burn_short": 9.9, "burn_long": 3.0}}}})
+    plane = AlertPlane(flap_damp_s=0.0)
+    plane.configure([parse_alert("b:burn(avail):>:2",
+                                 objectives=["avail"])])
+    try:
+        plane.evaluate(now=T0)
+        (inst,) = plane.snapshot(now=T0)["instances"]
+        assert inst["state"] == "firing" and inst["value"] == 3.0
+        assert inst["labels"] == {"tenant": "t0", "objective": "avail"}
+    finally:
+        plane.reset()
+
+
+def test_threshold_rule_without_history_surfaces_error():
+    plane = AlertPlane()
+    plane.configure([parse_alert("a:rate(mrtpu_x_total):>:1")])
+    try:
+        plane.evaluate(history=None, now=T0)
+        (rule,) = plane.snapshot(now=T0)["rules"]
+        assert "needs the history plane" in rule["last_error"]
+    finally:
+        plane.reset()
+
+
+# -- real sinks ---------------------------------------------------------------
+
+def test_webhook_sink_posts_notification():
+    hits = []
+
+    class _Hook(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            hits.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    thr = threading.Thread(target=srv.serve_forever, daemon=True)
+    thr.start()
+    try:
+        sink = WebhookSink("hook", f"127.0.0.1:{srv.server_address[1]}")
+        sink.deliver({"rule": "hot", "to": "firing", "seq": 3})
+        assert hits == [{"rule": "hot", "to": "firing", "seq": 3}]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_exec_sink_pipes_json_and_propagates_failure(tmp_path):
+    out = tmp_path / "notify.jsonl"
+    sink = ExecSink("tee", f"sh -c 'cat >> {out}'")
+    sink.deliver({"rule": "hot", "to": "firing", "seq": 7})
+    assert json.loads(out.read_text())["seq"] == 7
+    with pytest.raises((IOError, OSError)):
+        ExecSink("bad", "false").deliver({"rule": "hot"})
+
+
+# -- surfaces: validator, statusz, bundle -------------------------------------
+
+def _configured_global_plane(tmp_path, h):
+    alerts.PLANE.configure(
+        [parse_alert(f"hot:increase({FAMILY}[60]):>:5")],
+        log_dir=str(tmp_path / "alerts"))
+    alerts.PLANE.evaluate(history=h, now=T0 + 10.0)
+
+
+def test_validate_alerts_is_strict(tmp_path):
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    try:
+        _configured_global_plane(tmp_path, h)
+        doc = json.loads(json.dumps(alerts.alertz_doc(), default=float))
+        validate_alerts(doc)  # the real artifact passes
+        for mutate, msg in [
+                (lambda d: d.__setitem__("kind", "mrtpu-alert"), "kind"),
+                (lambda d: d.__setitem__("snapshot", []), "snapshot"),
+                (lambda d: d["snapshot"].__setitem__("rules", []),
+                 "rules"),
+                (lambda d: d["snapshot"]["rules"][0].pop("name"),
+                 "no name"),
+                (lambda d: d["snapshot"]["rules"][0].__setitem__(
+                    "op", "beyond"), "op"),
+                (lambda d: d["snapshot"]["instances"][0].__setitem__(
+                    "state", "screaming"), "state"),
+                (lambda d: d["snapshot"].__setitem__("instances", {}),
+                 "instances"),
+                (lambda d: d["snapshot"].__setitem__("counts", [3]),
+                 "counts")]:
+            bad = json.loads(json.dumps(doc))
+            mutate(bad)
+            with pytest.raises(ValueError, match=msg):
+                validate_alerts(bad)
+    finally:
+        alerts.PLANE.reset()
+        h.close()
+
+
+def test_statusz_and_bundle_carry_alerts(tmp_path):
+    from mapreduce_tpu.coord.docstore import MemoryDocStore
+    from mapreduce_tpu.obs import profile, statusz
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    try:
+        # unconfigured plane: every surface stays silent
+        assert alerts.alerts_snapshot() == {}
+        assert statusz.alerts_snapshot_section() == {}
+        assert "alerts" not in statusz.cluster_status(MemoryDocStore())
+        _configured_global_plane(tmp_path, h)
+        sec = statusz.alerts_snapshot_section()
+        assert sec["counts"] == {"firing": 1}
+        snap = statusz.cluster_status(MemoryDocStore())
+        assert snap["alerts"]["counts"] == {"firing": 1}
+        out_dir = str(tmp_path / "bundle")
+        profile.write_bundle(out_dir)
+        assert os.path.exists(os.path.join(out_dir, "alerts.json"))
+        loaded = profile.load_bundle(out_dir)
+        assert loaded["alerts"]["snapshot"]["counts"] == {"firing": 1}
+        # a corrupted artifact is rejected on load, not half-trusted
+        with open(os.path.join(out_dir, "alerts.json"), "w") as f:
+            json.dump({"kind": "mrtpu-alerts", "version": 1,
+                       "snapshot": {"rules": "?"}}, f)
+        with pytest.raises(ValueError):
+            profile.load_bundle(out_dir)
+    finally:
+        alerts.PLANE.reset()
+        h.close()
+
+
+def test_cli_render_alerts_section(tmp_path):
+    from mapreduce_tpu import cli
+    h = _probe_hist(tmp_path, [0.0, 9.0])
+    try:
+        _configured_global_plane(tmp_path, h)
+        alerts.PLANE.ack("hot")
+        text = "\n".join(cli._render_alerts(alerts.alerts_snapshot()))
+        assert "alerts: 1 rule(s)" in text
+        assert "FIRING" in text and "hot" in text and "acked" in text
+    finally:
+        alerts.PLANE.reset()
+        h.close()

@@ -58,11 +58,12 @@ def _healthz(port: int, timeout: float = 0.5):
         return None
 
 
-def _spawn_docserver(port: int, ha_dir: str) -> subprocess.Popen:
+def _spawn_docserver(port: int, ha_dir: str,
+                     extra=()) -> subprocess.Popen:
     return subprocess.Popen(
         [sys.executable, "-m", "mapreduce_tpu.cli", "docserver",
          "--host", "127.0.0.1", "--port", str(port),
-         "--ha-dir", ha_dir, "--ha-lease", str(LEASE)],
+         "--ha-dir", ha_dir, "--ha-lease", str(LEASE)] + list(extra),
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -298,3 +299,124 @@ def test_history_survives_board_failover(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=10)
+
+
+def test_alert_fires_exactly_once_across_failover(tmp_path):
+    """The alerting-plane chaos acceptance (ISSUE 19): a threshold rule
+    goes pending on the primary, the primary is SIGKILLed mid-window,
+    and the promoted standby replays the shared alert log, RESUMES the
+    pending timer (it does not restart), and fires EXACTLY once — the
+    webhook witness sees one firing delivery across the kill.  When
+    the condition clears, resolved is delivered too, and `cli alerts`
+    against the standby shows the whole lifecycle."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mapreduce_tpu.obs.collector import TelemetryPusher
+    from mapreduce_tpu.obs.metrics import counter
+
+    hits = []
+
+    class _Hook(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            hits.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    hook = ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    threading.Thread(target=hook.serve_forever, daemon=True).start()
+
+    def delivered(to):
+        return sum(1 for d in hits if d.get("to") == to)
+
+    def alertz(port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/alertz",
+                    timeout=0.5) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
+
+    ha_dir = str(tmp_path / "ha")
+    p1, p2 = _free_port(), _free_port()
+    alert_args = [
+        "--alert",
+        "probe:increase(mrtpu_alertchaos_probe_total[6]):>:4:2",
+        "--alert-webhook", f"pager=127.0.0.1:{hook.server_address[1]}",
+        "--alert-interval", "0.25", "--alert-damp", "0.5"]
+    procs = [_spawn_docserver(p1, ha_dir, alert_args),
+             _spawn_docserver(p2, ha_dir, alert_args)]
+    probe = counter("mrtpu_alertchaos_probe_total",
+                    "failover-spanning alert probe")
+    pusher = TelemetryPusher(f"127.0.0.1:{p1},127.0.0.1:{p2}",
+                             role="alertchaos", interval=60.0)
+    try:
+        for port in (p1, p2):
+            _wait(lambda port=port: _healthz(port) is not None, 30,
+                  f"docserver on {port} never served /healthz")
+        roles = _wait(
+            lambda: ({p: (_healthz(p) or {}).get("primary")
+                      for p in (p1, p2)}
+                     if any((_healthz(p) or {}).get("primary")
+                            for p in (p1, p2)) else None),
+            30, "no replica ever took the board lease")
+        prim_port = p1 if roles[p1] else p2
+        stby_port = p2 if prim_port == p1 else p1
+        prim = procs[0] if prim_port == p1 else procs[1]
+
+        # breach the threshold (increase 9 > 4 in the 6s window) and
+        # wait for the PRIMARY's evaluator to append the pending
+        # transition to the shared alert log
+        probe.inc(9)
+        _wait(pusher.flush, 30, "telemetry push never succeeded")
+        _wait(lambda: (((alertz(prim_port) or {}).get("snapshot") or {})
+                       .get("counts") or {}).get("pending"),
+              20, "the rule never went pending on the primary")
+
+        # open fire mid-window: pending logged, NOT yet firing
+        os.kill(prim.pid, signal.SIGKILL)
+        prim.wait(timeout=10)
+        assert delivered("firing") == 0, hits
+        _wait(lambda: (_healthz(stby_port) or {}).get("primary"), 30,
+              "standby never took over after SIGKILL")
+
+        # the promoted standby resumes the pending timer and fires —
+        # the webhook hears it exactly once
+        _wait(lambda: delivered("firing") >= 1, 30,
+              "promoted standby never fired the alert")
+        (firing,) = [d for d in hits if d["to"] == "firing"]
+        assert firing["rule"] == "probe" and firing["seq"] >= 1
+
+        # nothing pushes any more: the window drains, the damped
+        # instance resolves, resolved is delivered
+        _wait(lambda: delivered("resolved") >= 1, 40,
+              "resolved was never delivered after the window drained")
+        assert delivered("firing") == 1, hits
+        assert delivered("resolved") == 1, hits
+
+        # `cli alerts` against the STANDBY shows the lifecycle (it
+        # serves the tailed log), and the promotion fence bumped the
+        # log generation
+        out = subprocess.run(
+            [sys.executable, "-m", "mapreduce_tpu.cli", "alerts",
+             f"http://127.0.0.1:{stby_port}"],
+            stdout=subprocess.PIPE, timeout=30,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))).stdout.decode()
+        assert "alerts: 1 rule(s)" in out and "resolved=1" in out, out
+        snap = (alertz(stby_port) or {}).get("snapshot") or {}
+        assert snap["log"]["generation"] >= 2
+        assert snap["counts"] == {"resolved": 1}
+    finally:
+        pusher.stop(flush=False)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        hook.shutdown()
+        hook.server_close()

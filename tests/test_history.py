@@ -427,3 +427,109 @@ def test_diagnose_renders_trend_findings():
     text = analysis.render_diagnosis(report)
     assert "HISTORY TRENDS" in text
     assert any("trend:" in n for n in report["notes"])
+
+
+# -- query edge validation, tie-breaks, GC accounting, tail mode -------------
+
+def test_query_rejects_nonpositive_step_and_inverted_range(tmp_path):
+    h = _hist(tmp_path)
+    h.append_snapshot("p0", {_k("mrtpu_wc_total"): 5.0}, t=1000.0)
+    for step in (0, -5, 0.0):
+        with pytest.raises(ValueError, match="bad queryz step"):
+            h.query("mrtpu_wc_total", fn="increase", step=step,
+                    now=1100.0)
+    with pytest.raises(ValueError, match="empty history range"):
+        h.query("mrtpu_wc_total", start=900.0, end=800.0, now=1100.0)
+    # degenerate point range is empty too, not a zero-width bucket
+    with pytest.raises(ValueError, match="empty history range"):
+        h.query("mrtpu_wc_total", start=900.0, end=900.0, now=1100.0)
+    h.close()
+
+
+def test_top_series_tie_break_is_deterministic(tmp_path):
+    h = _hist(tmp_path)
+    # three series with IDENTICAL increase: rank must fall back to
+    # (name, labels), never dict/hash order
+    h.append_snapshot("p0", {_k("mrtpu_bb_total", task="z"): 5.0,
+                             _k("mrtpu_bb_total", task="a"): 5.0,
+                             _k("mrtpu_aa_total", task="m"): 5.0},
+                      t=1000.0)
+    rows = h.top_series(k=5, window_s=300.0, now=1100.0)
+    assert [(r["name"], r["labels"]["task"]) for r in rows] == [
+        ("mrtpu_aa_total", "m"), ("mrtpu_bb_total", "a"),
+        ("mrtpu_bb_total", "z")]
+    # a second reader replaying the same segments ranks identically
+    h2 = _hist(tmp_path)
+    assert h2.top_series(k=5, window_s=300.0, now=1100.0) == rows
+    h2.close()
+    h.close()
+
+
+def test_gc_counter_and_snapshot_rotation_accounting(tmp_path):
+    gc0 = REGISTRY.sum("mrtpu_history_gc_total", reason="size")
+    h = _hist(tmp_path, max_segment_bytes=1, keep_segments=2)
+    pad = "x" * 5000
+    for i in range(1, 7):
+        h.append_snapshot("p0", {_k("mrtpu_wc_total", pad=pad): float(i)},
+                          t=1000.0 + i)
+    snap = h.snapshot()
+    assert snap["rotations"] >= 4
+    assert snap["gc_segments"] >= 1
+    assert snap["segments"] <= 3   # keep-N held
+    assert REGISTRY.sum("mrtpu_history_gc_total",
+                        reason="size") - gc0 == snap["gc_segments"]
+    # the status CLI renders the rotation/GC suffix in the history row
+    from mapreduce_tpu import cli
+    (line,) = cli._render_history(snap)
+    assert "rotation(s)" in line and "gc'd" in line
+    h.close()
+
+
+def test_queryz_http_400_bodies_are_typed(tmp_path):
+    # the /queryz contract satellite: bad ranges answer 400 with a
+    # machine-readable {ok, type, error} body, not a bare status line
+    import http.client
+
+    from mapreduce_tpu.coord.docserver import DocServer
+
+    srv = DocServer(history_dir=str(tmp_path / "hist")).start_background()
+    try:
+        for qs, frag in (
+                ("metric=mrtpu_wc_total&step=0", "step"),
+                ("metric=mrtpu_wc_total&step=-5", "step"),
+                ("metric=mrtpu_wc_total&start=900&end=800",
+                 "empty history range")):
+            cnn = http.client.HTTPConnection(srv.host, srv.port,
+                                             timeout=10)
+            cnn.request("GET", f"/queryz?{qs}")
+            resp = cnn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 400
+            assert body["ok"] is False
+            assert body["type"] == "ValueError"
+            assert frag in body["error"]
+            cnn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_cli_history_follow_tail_cursor(capsys):
+    from mapreduce_tpu import cli
+
+    series = [{"labels": {"task": "wc"},
+               "points": [[10.0, 1.0], [20.0, 2.0]]}]
+    last = cli._print_history_points(series, float("-inf"))
+    assert last == 20.0
+    out = capsys.readouterr().out
+    assert "10.000" in out and "20.000" in out
+    # next poll returns an overlapping window: only the new step prints
+    series[0]["points"].append([30.0, 3.0])
+    assert cli._print_history_points(series, last) == 30.0
+    out = capsys.readouterr().out
+    assert "30.000" in out and "10.000" not in out
+    # no new steps → silent, cursor unchanged
+    assert cli._print_history_points(series, 30.0) == 30.0
+    assert capsys.readouterr().out == ""
+    # a bad --interval is rejected before any connection is attempted
+    assert cli.main(["history", "http://127.0.0.1:1", "--metric", "m",
+                     "--follow", "--interval", "0"]) == 2

@@ -237,6 +237,13 @@ _MONOTONIC_ONLY_MODULES = {
     # arithmetic would flap liveness and mis-time migrations
     os.path.join("mapreduce_tpu", "coord", "fleet.py"),
     os.path.join("mapreduce_tpu", "engine", "migrate.py"),
+    # the alerting plane: flap damping and resolve clocks are
+    # monotonic durations, while every persisted stamp (transition t,
+    # silence expiry) is minted through coord/docstore.now — a
+    # steppable clock here would flap pages or re-fire a silence
+    # early, and the pending-timer resume across failover depends on
+    # logged wall stamps never mixing with raw time.time()
+    os.path.join("mapreduce_tpu", "obs", "alerts.py"),
     # the Pallas hot-path plane: the kernel modules and the shared
     # compat layer sit INSIDE traced wave programs — they must read no
     # clocks at all (a clock read at trace time would bake a constant
