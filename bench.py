@@ -307,7 +307,8 @@ def _probe_wordcount(smoke: bool, sort_impl: str = None):
     return DeviceWordCount(make_mesh(), chunk_len=chunk_len, config=cfg)
 
 
-def compile_probe(cache_dir: str, smoke: bool) -> int:
+def compile_probe(cache_dir: str, smoke: bool,
+                  sort_impl: str = None) -> int:
     """Subprocess body for the cold/warm measurement: point the
     persistent cache at *cache_dir* BEFORE any compile (a fresh process
     is the only place that guarantee holds — XLA latches the cache at
@@ -324,7 +325,7 @@ def compile_probe(cache_dir: str, smoke: bool) -> int:
     # compile and call the cache broken
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-    wc = _probe_wordcount(smoke)
+    wc = _probe_wordcount(smoke, sort_impl=sort_impl)
     secs = wc.warm()
     from mapreduce_tpu.obs.compile import LEDGER
 
@@ -341,7 +342,8 @@ def compile_probe(cache_dir: str, smoke: bool) -> int:
     return 0
 
 
-def tiered_probe(cache_dir: str, smoke: bool) -> int:
+def tiered_probe(cache_dir: str, smoke: bool,
+                 sort_impl: str = "tiered") -> int:
     """Subprocess body for the cold-serving measurement: a genuinely
     COLD process (fresh empty *cache_dir*, nothing in the in-process
     ledger) submits a one-wave corpus through ``sort_impl='tiered'``
@@ -357,7 +359,11 @@ def tiered_probe(cache_dir: str, smoke: bool) -> int:
 
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-    wc = _probe_wordcount(smoke, sort_impl="tiered")
+    # the probe's tier witnesses (cold start, serving tier) only exist
+    # under a tiered policy — a concrete impl here would measure the
+    # wrong path and report vacuous tier fields
+    assert sort_impl in ("tiered", "tiered-radix"), sort_impl
+    wc = _probe_wordcount(smoke, sort_impl=sort_impl)
     eng = wc.engine
     # exactly ONE full wave: first_dispatch_s covers wave 0 only, and a
     # one-wave corpus keeps the probe's tail (the remaining waves the
@@ -383,13 +389,16 @@ def tiered_probe(cache_dir: str, smoke: bool) -> int:
     return 0
 
 
-def _run_probe(cache_dir: str, smoke: bool, tiered: bool = False) -> dict:
+def _run_probe(cache_dir: str, smoke: bool, tiered: bool = False,
+               sort_impl: str = None) -> dict:
     import subprocess
 
     cmd = [sys.executable, os.path.abspath(__file__),
            "--tiered-probe" if tiered else "--compile-probe", cache_dir]
     if smoke:
         cmd.append("--smoke")
+    if sort_impl:
+        cmd += ["--sort-impl", sort_impl]
     proc = subprocess.run(cmd, capture_output=True, text=True,
                           timeout=1800)
     if proc.returncode != 0:
@@ -405,7 +414,7 @@ def _run_probe(cache_dir: str, smoke: bool, tiered: bool = False) -> dict:
                        f"{proc.stdout[-2000:]}")
 
 
-def measure_cold_warm(smoke: bool) -> dict:
+def measure_cold_warm(smoke: bool, sort_impl: str = None) -> dict:
     """ROADMAP 2(c)'s two gated numbers, measured honestly: a FRESH
     temp cache dir makes the first fresh-process probe genuinely cold
     even on a machine whose real cache is warm, and the second probe —
@@ -414,9 +423,12 @@ def measure_cold_warm(smoke: bool) -> dict:
     to measure.  The parent process's own cache config is untouched."""
     import tempfile
 
+    # sort_impl (opt-in; None keeps the gated flagship config) points
+    # BOTH probes at a non-default concrete sort — e.g. 'radix' measures
+    # the no-comparator program's cold compile and its warm restart
     with tempfile.TemporaryDirectory(prefix="mrtpu_coldwarm_") as td:
-        cold = _run_probe(td, smoke)
-        warm = _run_probe(td, smoke)
+        cold = _run_probe(td, smoke, sort_impl=sort_impl)
+        warm = _run_probe(td, smoke, sort_impl=sort_impl)
     # the tiered cold-serving probe needs its OWN fresh cache dir: the
     # cold probe above just filled td with the variadic program, and a
     # tiered probe that found it would (correctly) skip tier-0 and
@@ -1299,6 +1311,84 @@ def check_smoke() -> int:
     assert _segscan.SEGMENT_BLOCK % 128 == 0
     assert _tokenize_mod.TOKENIZE_BLOCK % 128 == 0
 
+    # radix hot-path gate (ops/radix_sort; registry- and ledger-
+    # asserted, zero wall-clock comparisons): a sort_impl='radix'
+    # smoke run must (1) actually build the radix kernel programs
+    # (histogram + rank/scatter; trace-time build counter, interpret
+    # mode on this CPU tier), (2) keep the fused execution model —
+    # still exactly one wave-program dispatch per wave, zero merge
+    # dispatches, (3) fold bit-identically to the lax smoke run above
+    # (same corpus, same wave split), (4) bucket the radix wave
+    # program in the compile ledger WITHOUT adding any comparator-sort
+    # wave bucket (the radix program replaces lax.sort inside the wave
+    # — zero comparator compiles, not a comparator riding alongside),
+    # and (5) keep the exchange traffic matrix bit-equal to the host
+    # recompute — the fused in-kernel partition plan must not change
+    # the PR 9 matrix semantics.
+    def _comparator_wave_buckets() -> int:
+        return sum(
+            1 for rec in LEDGER.buckets()
+            if rec.get("program") == "wave"
+            and any("'variadic'" in e or "'argsort'" in e
+                    for e in rec.get("extra", [])))
+
+    kb_rh0 = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                          kernel="radix_hist")
+    kb_rs0 = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                          kernel="radix_scatter")
+    rw0 = REGISTRY.sum("mrtpu_device_waves_total")
+    rd0 = REGISTRY.sum("mrtpu_device_dispatches_total", program="wave")
+    cmp_buckets0 = _comparator_wave_buckets()
+    # same capacity sizing rationale as the pallas gate above: the fold
+    # is capacity-independent below overflow, and the small shapes keep
+    # the 16-pass interpreter-run radix program cheap on the CPU tier
+    wc_r = DeviceWordCount(
+        make_mesh(), chunk_len=4096,
+        config=EngineConfig(local_capacity=1024, exchange_capacity=512,
+                            out_capacity=1024, tile=512, tile_records=128,
+                            combine_in_scan=True, sort_impl="radix"))
+    tm_r = {}
+    counts_r = wc_r.count_bytes(corpus, timings=tm_r, waves=3)
+    assert counts_r == counts, (
+        "radix-sorted fold diverged from the lax smoke run")
+    assert tm_r["retries"] == 0, tm_r
+    r_waves = REGISTRY.sum("mrtpu_device_waves_total") - rw0
+    r_disp = (REGISTRY.sum("mrtpu_device_dispatches_total",
+                           program="wave") - rd0)
+    assert r_waves == tm_r["waves"] >= 2 and r_disp == r_waves, (
+        f"radix config broke one-dispatch-per-wave: {r_disp} dispatches "
+        f"for {r_waves} waves")
+    assert REGISTRY.sum("mrtpu_device_dispatches_total",
+                        program="merge") == 0
+    kb_rh = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                         kernel="radix_hist") - kb_rh0
+    kb_rs = REGISTRY.sum("mrtpu_pallas_kernel_builds_total",
+                         kernel="radix_scatter") - kb_rs0
+    assert kb_rh >= 1 and kb_rs >= 1, (
+        f"radix config built no radix kernels (hist {kb_rh}, scatter "
+        f"{kb_rs}) — the config did not dispatch the radix programs")
+    radix_buckets = [
+        rec for rec in LEDGER.buckets()
+        if rec.get("program") == "wave"
+        and any("'radix'" in e for e in rec.get("extra", []))]
+    assert radix_buckets, (
+        "no wave bucket in the compile ledger carries the radix config "
+        "token — the radix config never compiled a wave program")
+    assert _comparator_wave_buckets() == cmp_buckets0, (
+        "the radix run added a comparator-sort wave bucket to the "
+        "compile ledger — lax.sort compiled alongside the radix program")
+    # the fused partition plan rides the same dispatch: its counts ARE
+    # the traffic-matrix row, and must stay bit-equal both to the host
+    # recompute and to the lax run's matrix over the same chunking
+    host_m_r = wc_r.host_exchange_matrix(corpus, waves=3)
+    r_m = np.asarray(tm_r["exchange"]["matrix"], dtype=np.int64)
+    assert np.array_equal(r_m, host_m_r), (
+        "radix fused partition plan diverged from the host-recomputed "
+        "exchange traffic matrix")
+    assert np.array_equal(host_m_r, host_m), (
+        "host recompute drifted between the lax and radix smoke runs — "
+        "the matrix comparison above is not comparing like for like")
+
     # always-on-service gate (registry-only): the sustained mode runs
     # with the SESSION layer active — the fused execution model must
     # hold there too (exactly one wave-program dispatch per session
@@ -1723,6 +1813,9 @@ def check_smoke() -> int:
         "pallas_fold_identical": True,
         "pallas_kernel_builds": {"segreduce": kb_seg, "tokenize": kb_tok},
         "pallas_mfu": tm_p.get("mfu"),
+        "radix_fold_identical": True,
+        "radix_kernel_builds": {"hist": kb_rh, "scatter": kb_rs},
+        "radix_exchange_matrix_bit_equal": True,
         "second_build_cached": cached_delta,
         "sustained_records_per_s": sustained["sustained_records_per_s"],
         "submit_first_snapshot_p99_s":
@@ -2110,14 +2203,18 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    _si = (sys.argv[sys.argv.index("--sort-impl") + 1]
+           if "--sort-impl" in sys.argv else None)
     if "--compile-probe" in sys.argv:
         _i = sys.argv.index("--compile-probe")
         raise SystemExit(compile_probe(sys.argv[_i + 1],
-                                       smoke="--smoke" in sys.argv))
+                                       smoke="--smoke" in sys.argv,
+                                       sort_impl=_si))
     if "--tiered-probe" in sys.argv:
         _i = sys.argv.index("--tiered-probe")
         raise SystemExit(tiered_probe(sys.argv[_i + 1],
-                                      smoke="--smoke" in sys.argv))
+                                      smoke="--smoke" in sys.argv,
+                                      sort_impl=_si or "tiered"))
     if "--check" in sys.argv and "--smoke" in sys.argv:
         raise SystemExit(check_smoke())
     main()

@@ -354,13 +354,19 @@ def cmd_wordcount(argv: List[str]) -> int:
                    help="use the SPMD device engine instead of the "
                         "host job-board path")
     p.add_argument("--sort-impl", choices=("variadic", "argsort",
-                                           "tiered"), default=None,
-                   help="device-engine sort formulation: 'tiered' "
-                        "serves a cold machine on the fast-compiling "
-                        "argsort tier-0 and hot-swaps to the variadic "
-                        "tier-1 when its background compile lands "
-                        "(first results in the small compile's time); "
-                        "default is the module's config (variadic)")
+                                           "radix", "tiered",
+                                           "tiered-radix"), default=None,
+                   help="device-engine sort formulation: 'radix' is "
+                        "the Pallas LSD radix sort + fused exchange "
+                        "plan (no comparator compile, bit-identical "
+                        "results); 'tiered' serves a cold machine on "
+                        "the fast-compiling argsort tier-0 and "
+                        "hot-swaps to the variadic tier-1 when its "
+                        "background compile lands (first results in "
+                        "the small compile's time); 'tiered-radix' is "
+                        "the same policy steadying on the radix "
+                        "program; default is the module's config "
+                        "(variadic)")
     p.add_argument("--segment-impl", choices=("lax", "pallas"),
                    default=None,
                    help="device-engine segmented-reduce formulation "
@@ -2211,6 +2217,15 @@ def cmd_warmup(argv: List[str]) -> int:
                         "= both — a fully warmed machine never serves "
                         "tier-0, because the tiered engine's warmness "
                         "probe finds tier-1 primed and skips tiering")
+    p.add_argument("--sort-impl", choices=("variadic", "argsort",
+                                           "radix", "tiered",
+                                           "tiered-radix"), default=None,
+                   help="prime the wave program with this sort "
+                        "formulation instead of the --tier mapping: "
+                        "'radix' primes the Pallas radix program "
+                        "(no comparator compile), 'tiered-radix' "
+                        "primes argsort + radix (the radix-steadied "
+                        "tier pair); overrides --tier when given")
     p.add_argument("--segment-impl", choices=("lax", "pallas"),
                    default=None,
                    help="prime the wave program with this segmented-"
@@ -2261,10 +2276,13 @@ def cmd_warmup(argv: List[str]) -> int:
     wc = DeviceWordCount(mesh, chunk_len=args.chunk_len, config=cfg)
     # --tier: prime the argsort serving program ('0'), the variadic
     # steady-state program ('1'), or both ('tiered' precompiles both
-    # per-tier programs through the same ledger path a tiered run uses)
+    # per-tier programs through the same ledger path a tiered run
+    # uses); --sort-impl names a formulation directly and wins
     wc.config = _dc_replace(
-        wc.config, sort_impl={"0": "argsort", "1": "variadic",
-                              "both": "tiered"}[args.tier])
+        wc.config,
+        sort_impl=(args.sort_impl if args.sort_impl
+                   else {"0": "argsort", "1": "variadic",
+                         "both": "tiered"}[args.tier]))
     if args.segment_impl:
         wc.config = _dc_replace(wc.config,
                                 segment_impl=args.segment_impl)
@@ -2313,6 +2331,7 @@ def cmd_warmup(argv: List[str]) -> int:
                              + float(rec.get("lowering_s", 0.0)))
     names = {0: "tier 0 (argsort, fast-compile serving)",
              1: "tier 1 (variadic, steady state)",
+             2: "tier 2 (radix, no-comparator kernels)",
              None: "untiered"}
     print("per-tier summary:")
     for t in sorted(tiers, key=lambda x: (x is None, x)):

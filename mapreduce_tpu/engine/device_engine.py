@@ -151,12 +151,20 @@ class EngineConfig:
     #:     comparator compile; the steady-state tier-1 program);
     #:   'argsort' — two-pass stable 1-key argsort (compiles ~3x
     #:     faster, runs slower; the tier-0 serving program);
+    #:   'radix' — the Pallas LSD radix sort (ops/radix_sort): no
+    #:     comparator at all, so the dominant cold-compile cost
+    #:     disappears; the partition exchange fuses its routing plan
+    #:     into the same kernel family (one histogram pass yields both
+    #:     scatter ranks and the traffic-matrix row).  Bit-identical
+    #:     to 'variadic' (golden suite);
     #:   'tiered'  — dispatch-level policy (engine/tiering.py): a COLD
     #:     shape bucket is served on tier-0 immediately while one
     #:     background thread compiles tier-1, hot-swapped at a wave
     #:     boundary (bit-identical by lax.sort stability, so the swap
     #:     is invisible in results); warm buckets go straight to
-    #:     tier-1 and nothing changes.
+    #:     tier-1 and nothing changes;
+    #:   'tiered-radix' — same policy with the radix program as the
+    #:     steady-state tier (serve argsort cold, hot-swap to radix).
     sort_impl: str = "variadic"
     #: skew-aware partition assignment (engine/autotune.py): route each
     #: record through a replicated ``[B] int32`` bucket->partition
@@ -226,7 +234,15 @@ def _wave_donate_argnums(cfg: "EngineConfig"):
             else _WAVE_DONATE_ARGNUMS)
 
 
-_SORT_IMPLS = ("variadic", "argsort", "tiered")
+_SORT_IMPLS = ("variadic", "argsort", "radix", "tiered", "tiered-radix")
+#: concrete (traceable) sort programs — what _program may be handed
+_CONCRETE_SORT_IMPLS = ("variadic", "argsort", "radix")
+
+
+def _is_tiered(sort_impl: str) -> bool:
+    """True for the dispatch-level tier policies (resolved by the engine
+    into concrete per-tier configs before any tracing)."""
+    return sort_impl in ("tiered", "tiered-radix")
 _SEGMENT_IMPLS = ("lax", "pallas")
 _TOKENIZE_IMPLS = ("lax", "pallas")
 
@@ -273,20 +289,22 @@ def validate_partition_map(pmap, buckets: int,
 
 
 def _tier_cfgs(cfg: EngineConfig):
-    """The two concrete per-tier program configs a ``'tiered'`` policy
-    resolves to: (tier-0 argsort, tier-1 variadic).  The accumulator
-    layout is identical across them — only the sort formulation inside
-    the program differs — so the donated carry threads straight through
-    a mid-run hot swap."""
+    """The two concrete per-tier program configs a tier policy resolves
+    to: (tier-0 argsort, steady tier).  ``'tiered'`` steadies on the
+    variadic program, ``'tiered-radix'`` on the radix program.  The
+    accumulator layout is identical across them — only the sort
+    formulation inside the program differs — so the donated carry
+    threads straight through a mid-run hot swap."""
+    steady = "radix" if cfg.sort_impl == "tiered-radix" else "variadic"
     return (replace(cfg, sort_impl="argsort"),
-            replace(cfg, sort_impl="variadic"))
+            replace(cfg, sort_impl=steady))
 
 
 def _steady_cfg(cfg: EngineConfig) -> EngineConfig:
-    """The steady-state program config: ``'tiered'`` normalizes to the
-    tier-1 variadic config so shared satellites (accumulator-init
-    program, fin-row avals) key identically to a pure tier-1 engine."""
-    return (_tier_cfgs(cfg)[1] if cfg.sort_impl == "tiered" else cfg)
+    """The steady-state program config: a tier policy normalizes to its
+    steady tier's config so shared satellites (accumulator-init
+    program, fin-row avals) key identically to an untiered engine."""
+    return (_tier_cfgs(cfg)[1] if _is_tiered(cfg.sort_impl) else cfg)
 
 
 def _capacities(cfg: EngineConfig) -> dict:
@@ -510,10 +528,10 @@ class DeviceEngine:
     # -- the SPMD program --------------------------------------------------
 
     def _program(self, cfg: EngineConfig):
-        # a 'tiered' policy never reaches tracing: the dispatch layer
-        # (engine/tiering.py) resolves it to one of the two concrete
+        # a tier policy never reaches tracing: the dispatch layer
+        # (engine/tiering.py) resolves it to one of the concrete
         # per-tier configs first
-        assert cfg.sort_impl in ("variadic", "argsort"), cfg.sort_impl
+        assert cfg.sort_impl in _CONCRETE_SORT_IMPLS, cfg.sort_impl
         map_fn = self.map_fn
         local_op, local_unit, fin_op = _stage_ops(cfg)
 
@@ -645,7 +663,14 @@ class DeviceEngine:
                                     cfg.exchange_capacity,
                                     carry=(acc_k[0], acc_v[0], acc_p[0],
                                            acc_valid[0]),
-                                    pmap=pmap)
+                                    pmap=pmap,
+                                    # radix programs fuse the routing
+                                    # plan into the kernel family: one
+                                    # histogram pass yields both the
+                                    # scatter ranks and ex.counts
+                                    impl=("radix"
+                                          if cfg.sort_impl == "radix"
+                                          else "lax"))
 
             fin = sorted_unique_reduce(
                 ex.keys, ex.values, ex.payload, ex.valid, cfg.out_capacity,
@@ -712,7 +737,8 @@ class DeviceEngine:
             replay=lambda structs: self._replay_info(cfg, structs),
             # which compile tier this formulation is (registry schema
             # v2: buckets record where their best_compile_s came from)
-            tier={"argsort": 0, "variadic": 1}[cfg.sort_impl],
+            tier={"argsort": 0, "variadic": 1,
+                  "radix": 2}[cfg.sort_impl],
             donate_argnums=_wave_donate_argnums(cfg))
 
     def _get_compiled(self, cfg: EngineConfig):
@@ -768,13 +794,13 @@ class DeviceEngine:
 
     def _wave_fn(self, cfg: EngineConfig):
         """The wave-program callable an attempt dispatches: the
-        compiled program itself, or — under ``sort_impl='tiered'`` — a
+        compiled program itself, or — under a tiered policy — a
         fresh :class:`~.tiering.TieredWaveDispatcher` that serves cold
-        buckets on tier-0 and hot-swaps to tier-1 at a wave boundary.
-        Per-attempt on purpose: a capacity retry re-probes warmness at
-        the NEW capacities and re-enters tier-0 instead of paying the
-        full tier-1 compile mid-retry."""
-        if cfg.sort_impl != "tiered":
+        buckets on tier-0 and hot-swaps to the steady tier at a wave
+        boundary.  Per-attempt on purpose: a capacity retry re-probes
+        warmness at the NEW capacities and re-enters tier-0 instead of
+        paying the full steady-tier compile mid-retry."""
+        if not _is_tiered(cfg.sort_impl):
             return self._get_compiled(cfg)
         from .tiering import TieredWaveDispatcher
 
@@ -1075,17 +1101,20 @@ class DeviceEngine:
         # the fused fold re-sorts the accumulator rows (out_capacity
         # running uniques) into every wave's final merge pass; the
         # argsort tier additionally pays the second sort pass and the
-        # permutation gathers (tier-0's runtime price); segment_impl
-        # picks between the scan-ladder term and the fused-kernel term
-        # (one pass over the records instead of log2(N) ladder passes)
-        # so a pallas-served run's MFU/roofline gauges model the program
-        # that actually ran
+        # permutation gathers (tier-0's runtime price); the radix tier
+        # replaces the comparator n·log(n) terms with the digit-pass
+        # formulation (passes × lane bytes + histogram/scatter flops);
+        # segment_impl picks between the scan-ladder term and the
+        # fused-kernel term (one pass over the records instead of
+        # log2(N) ladder passes) so a kernel-served run's MFU/roofline
+        # gauges model the program that actually ran
         return _profile.analytic_costs(input_bytes, n_records,
                                        record_bytes,
                                        fold_records=cfg.out_capacity,
                                        argsort=(cfg.sort_impl
                                                 == "argsort"),
-                                       segment_impl=cfg.segment_impl)
+                                       segment_impl=cfg.segment_impl,
+                                       sort_impl=cfg.sort_impl)
 
     def precompile(self, row_shape, row_dtype=np.uint8,
                    k: int = None) -> float:
@@ -1132,10 +1161,10 @@ class DeviceEngine:
         if cfg.partition_map:
             shapes += (jax.ShapeDtypeStruct(
                 (self.partition_buckets,), np.int32, sharding=rep),)
-        # a 'tiered' policy primes BOTH per-tier programs: a warmed
+        # a tier policy primes BOTH per-tier programs: a warmed
         # machine must never fall back to tier-0 serving (the warmness
-        # probe sees the tier-1 bucket and skips tiering outright)
-        cfgs = _tier_cfgs(cfg) if cfg.sort_impl == "tiered" else (cfg,)
+        # probe sees the steady-tier bucket and skips tiering outright)
+        cfgs = _tier_cfgs(cfg) if _is_tiered(cfg.sort_impl) else (cfg,)
         with quiet_unusable_donation():
             for c in cfgs:
                 self._get_compiled(c).aot(shapes)
@@ -1272,7 +1301,7 @@ class DeviceEngine:
         t_attempt_compute = 0.0  # final attempt only (the MFU clock)
         retries = 0
         cost_shapes = None  # avals of the dispatched wave (cost model)
-        tiered = cfg.sort_impl == "tiered"
+        tiered = _is_tiered(cfg.sort_impl)
         #: monotonic instant the FIRST wave program of the run was
         #: dispatched — run-entry to here is the cold time-to-serving
         #: the tiered formulation exists to shrink (bench.py gates it

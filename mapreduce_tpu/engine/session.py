@@ -63,11 +63,12 @@ _CHUNKS = _obs.counter(
 _SESSION_WAVES = _obs.counter(
     "mrtpu_session_waves_total",
     "fused wave programs dispatched by the session layer (labels: "
-    "task, tier=0|1|-) — the bench smoke asserts device dispatches "
-    "match this one-for-one while the session is the only engine "
-    "user.  Under sort_impl='tiered' the tier label attributes a cold "
-    "tenant's first waves to tier-0 serving (the SLO plane's "
-    "compile-stall-vs-serving discriminator); '-' is an untiered "
+    "task, tier=0|1|<impl>|-) — the bench smoke asserts device "
+    "dispatches match this one-for-one while the session is the only "
+    "engine user.  Under a tiered policy the tier label attributes a "
+    "cold tenant's first waves to tier-0 serving (the SLO plane's "
+    "compile-stall-vs-serving discriminator); a non-variadic steady "
+    "tier labels as its impl name (e.g. 'radix'); '-' is an untiered "
     "session")
 _SNAPSHOTS = _obs.counter(
     "mrtpu_session_snapshots_total",
@@ -348,8 +349,10 @@ class EngineSession:
 
     def _wave_fn(self):
         """The session's wave callable: the compiled program, or (for
-        ``sort_impl='tiered'``) the session-lifetime tiered dispatcher."""
-        if self.config.sort_impl != "tiered":
+        a tiered policy) the session-lifetime tiered dispatcher."""
+        from .device_engine import _is_tiered
+
+        if not _is_tiered(self.config.sort_impl):
             return self.engine._get_compiled(self.config)
         if self._dispatcher is None:
             self._dispatcher = self.engine._wave_fn(self.config)
@@ -452,7 +455,9 @@ class EngineSession:
             # compiled program also carries a .tier (its formulation),
             # but labelling a plain argsort session "0" would read as
             # cold serving on every SLO dashboard forever
-            tiered = self.config.sort_impl == "tiered"
+            from .device_engine import _is_tiered
+
+            tiered = _is_tiered(self.config.sort_impl)
             feed_oflow = 0
             wave_tiers: Dict[str, int] = {}
             pmap_args = self._pmap_args(st)
@@ -477,7 +482,7 @@ class EngineSession:
                         # both labels, which is exactly the record the
                         # SLO plane attributes a cold tenant's first
                         # snapshot with
-                        tier_label = str(fn.tier) if tiered else "-"
+                        tier_label = fn.tier_label if tiered else "-"
                         wave_tiers[tier_label] = (
                             wave_tiers.get(tier_label, 0) + 1)
                         # lanes 0-3 records, lane 6+ traffic — the next
@@ -615,6 +620,12 @@ class EngineSession:
                 # pre-kernel key set exactly
                 out["segment_impl"] = self.config.segment_impl
                 out["tokenize_impl"] = self.config.tokenize_impl
+            if self.config.sort_impl != "variadic":
+                # same contract for the sort formulation: a non-default
+                # program family (argsort serving, a tiered policy, the
+                # radix kernels) is visible in serving stats; default
+                # variadic sessions keep the pre-radix key set exactly
+                out["sort_impl"] = self.config.sort_impl
             return out
 
     def coldest_task(self) -> Optional[str]:

@@ -11,14 +11,16 @@ answer gets both (``EngineConfig.sort_impl = 'tiered'``):
   built and dispatched IMMEDIATELY on a cold shape bucket, so the
   first records flow in the time of the fast compile, not the full
   one;
-* **tier-1** — the variadic formulation (``sort_impl='variadic'``):
-  compiled by ONE background thread per engine through the compile
-  ledger's ``aot()`` (so the ledger, shape registry and cost model see
-  it exactly once, like any other compile), and hot-swapped in at a
-  wave boundary.  The two programs are bit-identical by ``lax.sort``
-  stability and share the donated accumulator layout, so the carry
-  threads straight through the swap and the swap is invisible in
-  results (the golden suite pins it).
+* **tier-1** — the steady-state formulation: the variadic 2-key sort
+  (``sort_impl='variadic'``) under the ``'tiered'`` policy, or the
+  Pallas radix program (``sort_impl='radix'``) under
+  ``'tiered-radix'``.  Compiled by ONE background thread per engine
+  through the compile ledger's ``aot()`` (so the ledger, shape
+  registry and cost model see it exactly once, like any other
+  compile), and hot-swapped in at a wave boundary.  The programs are
+  bit-identical (``lax.sort`` stability; the radix golden suite) and
+  share the donated accumulator layout, so the carry threads straight
+  through the swap and the swap is invisible in results.
 
 Warm buckets — the ledger's in-process executable cache or the on-disk
 shape registry next to an enabled persistent cache already knows the
@@ -49,9 +51,12 @@ logger = logging.getLogger("mapreduce_tpu.engine.tiering")
 _TIER_DISPATCHES = _obs.counter(
     "mrtpu_compile_tier_total",
     "wave-program dispatches by compile tier (labels: program, "
-    "tier=0|1, task) — under sort_impl='tiered', tier=0 dispatches are "
-    "the fast-compile argsort program serving a cold bucket while "
-    "tier-1 specializes in the background")
+    "tier=0|1|<impl>, task) — under a tiered policy, tier=0 dispatches "
+    "are the fast-compile argsort program serving a cold bucket while "
+    "the steady tier specializes in the background; the steady tier "
+    "labels as '1' when it is the variadic program and as the impl "
+    "name (e.g. 'radix') otherwise, so an impl-served dispatch is "
+    "distinguishable in /statusz and diagnose")
 _TIER_SWAPS = _obs.counter(
     "mrtpu_tier_swaps_total",
     "mid-run tier-0 -> tier-1 hot swaps at a wave boundary (labels: "
@@ -195,7 +200,8 @@ class TierSpecializer:
 
 
 class TieredWaveDispatcher:
-    """The wave-program callable for ``sort_impl='tiered'``.
+    """The wave-program callable for the tiered policies
+    (``sort_impl='tiered'`` / ``'tiered-radix'``).
 
     Drop-in where the engine dispatched its compiled wave program: the
     first call probes the ledger's warmness for the tier-1 bucket at
@@ -215,12 +221,14 @@ class TieredWaveDispatcher:
     """
 
     def __init__(self, engine: Any, cfg: Any, task: str = "-") -> None:
-        if cfg.sort_impl != "tiered":
-            raise ValueError(f"TieredWaveDispatcher needs "
-                             f"sort_impl='tiered', got {cfg.sort_impl!r}")
+        from .device_engine import _is_tiered, _tier_cfgs
+
+        if not _is_tiered(cfg.sort_impl):
+            raise ValueError(f"TieredWaveDispatcher needs a tiered "
+                             f"policy ('tiered' or 'tiered-radix'), "
+                             f"got {cfg.sort_impl!r}")
         self._engine = engine
-        self._cfg0 = replace(cfg, sort_impl="argsort")
-        self._cfg1 = replace(cfg, sort_impl="variadic")
+        self._cfg0, self._cfg1 = _tier_cfgs(cfg)
         self._fn1 = engine._get_compiled(self._cfg1)
         self._fn0: Optional[Any] = None  # built only when actually cold
         self._task = task or "-"
@@ -237,6 +245,18 @@ class TieredWaveDispatcher:
         the cost/memory models should lower (their ``aot()`` re-serves
         the exact executable the run used)."""
         return self._cfg0 if self.tier == 0 else self._cfg1
+
+    @property
+    def tier_label(self) -> str:
+        """Metric label for the serving tier: ``'0'``/``'1'`` for the
+        classic two-tier taxonomy, the impl name (e.g. ``'radix'``)
+        when the steady tier is not the variadic program — so an
+        impl-served dispatch is distinguishable in /statusz and
+        diagnose without renaming the existing gate keys."""
+        if self.tier != 1:
+            return str(self.tier)
+        impl = self._cfg1.sort_impl
+        return "1" if impl == "variadic" else impl
 
     def _decide(self, args: Tuple[Any, ...]) -> None:
         from ..obs.compile import fingerprint
@@ -281,6 +301,6 @@ class TieredWaveDispatcher:
             self._maybe_swap()
         fn = self._fn1 if self.tier == 1 else self._fn0
         out = fn(*args)
-        _TIER_DISPATCHES.inc(program="wave", tier=str(self.tier),
+        _TIER_DISPATCHES.inc(program="wave", tier=self.tier_label,
                              task=self._task)
         return out

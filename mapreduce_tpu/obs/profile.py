@@ -143,13 +143,25 @@ _SEGREDUCE_KERNEL_FLOPS = 12
 #: buffer) vs the kernel's single read+write pass
 _SEGSCAN_LAX_BYTE_PASSES = 8
 _SEGREDUCE_KERNEL_BYTE_PASSES = 1
+#: the radix formulation (ops/radix_sort): 4-bit digits over the
+#: 64-bit key = 16 digit passes, independent of record count — NO
+#: comparator ladder at all.  Per record per pass: the 16-lane onehot
+#: histogram/rank work plus the scatter index arithmetic.
+_RADIX_PASSES = 16
+_RADIX_HIST_FLOPS = 16   # onehot compare+add across the 16 buckets
+_RADIX_SCATTER_FLOPS = 8  # rank gather + offset add + scatter address
+#: bytes per radix pass: the kernel moves only the three sort lanes
+#: (k1, k2, perm = 12B/row) each pass; the full record is gathered
+#: ONCE by the rank-sort transport after the final pass.
+_RADIX_LANE_BYTES = 12
 
 
 def analytic_costs(input_bytes: int, n_records: int,
                    record_bytes: int,
                    fold_records: int = 0,
                    argsort: bool = False,
-                   segment_impl: str = "lax") -> Dict[str, float]:
+                   segment_impl: str = "lax",
+                   sort_impl: Optional[str] = None) -> Dict[str, float]:
     """Rough cost of one engine wave when XLA's model is unavailable:
     the program is sort-dominated (device_engine.py module doc), so
     FLOPs ≈ records × log2(records) compare-exchanges + a
@@ -170,10 +182,15 @@ def analytic_costs(input_bytes: int, n_records: int,
     segmented_scan + ladder_cumsum — several full read+write passes
     over the sorted records), ``"pallas"`` the fused kernel's single
     VMEM-tiled pass, so MFU/roofline gauges and the ``cost_analysis``
-    fallback agree on which program actually ran.  An estimate with
-    the right shape and order of magnitude — labelled
-    ``source="analytic"`` everywhere it lands so nobody mistakes it
-    for a measurement."""
+    fallback agree on which program actually ran.  ``sort_impl="radix"``
+    replaces the comparator ``n·log2(n)`` terms entirely with the
+    radix formulation (ops/radix_sort): a FIXED 16 digit passes over
+    the 64-bit key, each paying the 16-bucket histogram + stable
+    scatter per record and moving only the three 12-byte sort lanes,
+    plus one full-record gather after the final pass — no comparator
+    ladder ran, so none is modelled.  An estimate with the right
+    shape and order of magnitude — labelled ``source="analytic"``
+    everywhere it lands so nobody mistakes it for a measurement."""
     import math
 
     if segment_impl == "pallas":
@@ -182,19 +199,37 @@ def analytic_costs(input_bytes: int, n_records: int,
     else:
         seg_flops = _SEGSCAN_FLOPS
         seg_byte_passes = _SEGSCAN_LAX_BYTE_PASSES
+    radix = sort_impl == "radix"
+    rb = max(int(record_bytes), 1)
     n = max(int(n_records), 1)
     passes = max(int(math.ceil(math.log2(n))), 1)
+    if radix:
+        # per-record, record-count-independent pass structure
+        sort_flops_per_rec = (_RADIX_PASSES
+                              * (_RADIX_HIST_FLOPS + _RADIX_SCATTER_FLOPS))
+        # lanes moved each pass + the one post-sort record gather
+        sort_bytes_per_rec = (2 * _RADIX_LANE_BYTES * _RADIX_PASSES
+                              + 2 * rb)
+        flops = float(n * sort_flops_per_rec + n * seg_flops)
+        nbytes = float(max(int(input_bytes), 0)
+                       + n * sort_bytes_per_rec
+                       + 2 * n * rb * seg_byte_passes)
+        if fold_records > 0:
+            m = int(fold_records)
+            flops += float(m * sort_flops_per_rec + m * seg_flops)
+            nbytes += float(m * sort_bytes_per_rec
+                            + 2 * m * rb * seg_byte_passes)
+        return {"flops": flops, "bytes": nbytes}
     flops = float(n * passes * _SORT_CMP_FLOPS + n * seg_flops)
     nbytes = float(max(int(input_bytes), 0)
-                   + 2 * n * max(int(record_bytes), 1) * passes
-                   + 2 * n * max(int(record_bytes), 1) * seg_byte_passes)
+                   + 2 * n * rb * passes
+                   + 2 * n * rb * seg_byte_passes)
     if fold_records > 0:
         m = int(fold_records)
         fold_passes = max(int(math.ceil(math.log2(m))), 1)
         flops += float(m * fold_passes * _SORT_CMP_FLOPS
                        + m * seg_flops)
-        nbytes += float(2 * m * max(int(record_bytes), 1)
-                        * (fold_passes + seg_byte_passes))
+        nbytes += float(2 * m * rb * (fold_passes + seg_byte_passes))
     if argsort:
         # second sort ladder (the [key, perm] pair: ~12B/row) + one
         # permutation gather of every record lane, per sorted batch

@@ -436,6 +436,12 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
       buckets on, at the cost of the extra permutation gathers
       (measured ~2.6x slower end to end at bench shapes, which is why
       it is a serving tier and not the steady state).
+    * ``"radix"`` — NO comparator: the Pallas LSD radix sort of
+      ops/radix_sort (4-bit digits, 16 passes over the 64-bit key),
+      bit-identical to the variadic permutation (the golden suite pins
+      it); record lanes always use the rank-sort gather transport.
+      The comparator lowering — the dominant cold-compile cost —
+      disappears entirely from the program.
 
     ``segment_impl`` picks the post-sort segmented-reduce formulation:
 
@@ -453,10 +459,11 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
       The run-end compaction below is gather-based either way and is
       shared verbatim between the two implementations.
     """
-    if sort_impl not in ("variadic", "argsort"):
-        raise ValueError(f"sort_impl must be 'variadic' or 'argsort' "
-                         f"here, got {sort_impl!r} (the 'tiered' policy "
-                         "is resolved by the engine before tracing)")
+    if sort_impl not in ("variadic", "argsort", "radix"):
+        raise ValueError(f"sort_impl must be 'variadic', 'argsort' or "
+                         f"'radix' here, got {sort_impl!r} (the tiered "
+                         "policies are resolved by the engine before "
+                         "tracing)")
     if segment_impl not in ("lax", "pallas"):
         raise ValueError(f"segment_impl must be 'lax' or 'pallas', "
                          f"got {segment_impl!r}")
@@ -490,6 +497,16 @@ def sorted_unique_reduce(keys: jax.Array, values, payload: jax.Array,
         _k2s, p1 = jax.lax.sort((k2, iota), num_keys=1)
         k1s, perm = jax.lax.sort((k1[p1], p1), num_keys=1)
         k2s = k2[perm]
+        v2s = v2[perm] if n_val_lanes else None
+        vals_s = [v2s[:, i] for i in range(n_val_lanes)]
+        pay_s = payload[perm]
+        pays_s = [pay_s[:, i] for i in range(Q)]
+    elif sort_impl == "radix":
+        # no comparator at all: Pallas LSD radix over the hash-key lanes
+        # (ops/radix_sort), bit-identical to the variadic permutation;
+        # record lanes always ride the rank-sort gather transport
+        from .radix_sort import radix_sort_pairs
+        k1s, k2s, perm = radix_sort_pairs(k1, k2, interpret=interpret)
         v2s = v2[perm] if n_val_lanes else None
         vals_s = [v2s[:, i] for i in range(n_val_lanes)]
         pay_s = payload[perm]

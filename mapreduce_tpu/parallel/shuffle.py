@@ -41,7 +41,8 @@ def partition_exchange(keys: jax.Array, values: jax.Array,
                        payload: jax.Array, valid: jax.Array,
                        axis_name: str, capacity: int,
                        carry: Optional[Tuple] = None,
-                       pmap: Optional[jax.Array] = None) -> Exchanged:
+                       pmap: Optional[jax.Array] = None,
+                       impl: str = "lax") -> Exchanged:
     """Exchange records so device ``p`` ends up with every record whose
     ``key_hi % P == p``.  Must run inside ``shard_map`` over *axis_name*.
 
@@ -67,7 +68,19 @@ def partition_exchange(keys: jax.Array, values: jax.Array,
     rebalanced table routes each hot bucket wherever the controller
     binned it, inside the same compiled program (the table is an
     input, not a constant — no recompile per rebalance).
+
+    ``impl`` picks the routing-plan formulation: ``"lax"`` (default)
+    is the one-hot cumsum below; ``"radix"`` fuses the plan into the
+    radix kernel program (ops/radix_sort.radix_partition_plan) — ONE
+    destination-digit histogram kernel yields both the scatter ranks
+    and the ``counts`` traffic-matrix row, deleting the separate
+    count pass.  Both are bit-identical in every output field (the
+    golden suite pins it); buffer packing, the collective, and the
+    carry prepend are shared verbatim.
     """
+    if impl not in ("lax", "radix"):
+        raise ValueError(f"exchange impl must be 'lax' or 'radix', "
+                         f"got {impl!r}")
     P = jax.lax.psum(1, axis_name)
     n = keys.shape[0]
     if pmap is None:
@@ -78,15 +91,20 @@ def partition_exchange(keys: jax.Array, values: jax.Array,
         dest = pmap[bucket].astype(jnp.int32)
     dest = jnp.where(valid, dest, P)  # invalid -> out-of-range, dropped
 
-    # rank of each row within its destination bucket, via one-hot cumsum:
-    # rank[i] = #{j < i : dest[j] == dest[i]}   (O(N*P) elementwise — P is
-    # the mesh size, small; avoids a sort)
-    onehot = (dest[:, None] == jnp.arange(P)[None, :]).astype(jnp.int32)
-    rank = jnp.take_along_axis(
-        jnp.cumsum(onehot, axis=0) - 1,
-        jnp.clip(dest, 0, P - 1)[:, None], axis=1)[:, 0]
-
-    counts = onehot.sum(axis=0)  # [P] rows wanted per destination
+    # rank of each row within its destination bucket; counts[d] = rows
+    # wanted per destination (this device's traffic-matrix row)
+    if impl == "radix":
+        # fused plan: one histogram kernel pass feeds both outputs
+        from ..ops.radix_sort import radix_partition_plan
+        rank, counts = radix_partition_plan(dest, P)
+    else:
+        # one-hot cumsum: rank[i] = #{j < i : dest[j] == dest[i]}
+        # (O(N*P) elementwise — P is the mesh size, small; avoids a sort)
+        onehot = (dest[:, None] == jnp.arange(P)[None, :]).astype(jnp.int32)
+        rank = jnp.take_along_axis(
+            jnp.cumsum(onehot, axis=0) - 1,
+            jnp.clip(dest, 0, P - 1)[:, None], axis=1)[:, 0]
+        counts = onehot.sum(axis=0)
     overflow = jnp.maximum(counts - capacity, 0).sum()
 
     def scatter(arr, fill=0):

@@ -255,6 +255,7 @@ _MONOTONIC_ONLY_MODULES = {
     os.path.join("mapreduce_tpu", "ops", "segscan.py"),
     os.path.join("mapreduce_tpu", "ops", "tokenize.py"),
     os.path.join("mapreduce_tpu", "ops", "flash_attention.py"),
+    os.path.join("mapreduce_tpu", "ops", "radix_sort.py"),
 }
 
 #: the monotonic family plus the two non-clock time functions
